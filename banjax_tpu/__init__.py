@@ -1,0 +1,3 @@
+"""banjax-tpu: TPU-native DDoS-mitigation decision engine (banjax-compatible)."""
+
+__version__ = "0.1.0"

@@ -1,0 +1,100 @@
+"""Hot-reloadable config holder.
+
+Reference behavior: /root/reference/internal/config_holder.go:27-161 — an
+atomically-swapped immutable Config snapshot. `reload()` re-reads the file
+preserving restart_time; load() embeds the two challenge HTML pages (or reads
+them from configured paths), validates required keys (server_log_file,
+iptables_ban_seconds, kafka_brokers), and applies standalone-testing overrides
+(disable Kafka, swap log paths to the testing files).
+
+In CPython an attribute read/write of an object reference is atomic under the
+GIL, which gives the same read-mostly snapshot semantics as Go's
+atomic.Pointer. Callers must take a local `config = holder.get()` once per
+request/line and use only that snapshot, exactly as the Go code does.
+
+When the TPU matcher is enabled a reload also recompiles the rule NFA and
+re-uploads the transition tensors (handled by the matcher runner observing the
+snapshot generation counter).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+from banjax_tpu.config.schema import Config, config_from_yaml_text, default_hostname
+
+log = logging.getLogger(__name__)
+
+_PAGES_DIR = Path(__file__).resolve().parent.parent / "httpapi" / "pages"
+
+
+def _load_config(
+    path: str, restart_time: int, standalone_testing: bool, debug: bool
+) -> Config:
+    """Port of config_holder.go load() (:68-161)."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+
+    config = config_from_yaml_text(text, standalone_testing_default=standalone_testing)
+    config.restart_time = restart_time
+    config.reload_time = int(time.time())
+    config.hostname = default_hostname()
+
+    if config.sha_inv_challenge_html:
+        log.info("INIT: reading SHA-inverse challenge HTML from %s", config.sha_inv_challenge_html)
+        config.challenger_bytes = Path(config.sha_inv_challenge_html).read_bytes()
+    else:
+        config.challenger_bytes = (_PAGES_DIR / "sha-inverse-challenge.html").read_bytes()
+
+    if config.password_protected_path_html:
+        log.info("INIT: reading password page HTML from %s", config.password_protected_path_html)
+        config.password_page_bytes = Path(config.password_protected_path_html).read_bytes()
+    else:
+        config.password_page_bytes = (_PAGES_DIR / "password-protected-path.html").read_bytes()
+
+    if not config.debug and debug:
+        config.debug = True
+
+    if config.standalone_testing:
+        # config_holder.go:139-145 — make the process self-hosting for tests.
+        config.disable_kafka = True
+        config.server_log_file = "testing-log-file.txt"
+        config.banning_log_file = "banning-log-file.txt"
+
+    if not config.server_log_file:
+        raise ValueError("config needs server_log_file")
+    if not config.iptables_ban_seconds:
+        raise ValueError("config needs iptables_ban_seconds")
+    if not config.kafka_brokers:
+        raise ValueError("config needs kafka_brokers")
+
+    return config
+
+
+class ConfigHolder:
+    """Snapshot holder; `get()` returns the latest immutable Config."""
+
+    def __init__(self, path: str, standalone_testing: bool = False, debug: bool = False):
+        self._path = path
+        self._lock = threading.Lock()  # serializes reloads, not reads
+        restart_time = int(time.time())
+        self._config = _load_config(path, restart_time, standalone_testing, debug)
+        self.generation = 0  # bumped on every successful reload
+
+    def get(self) -> Config:
+        return self._config
+
+    def reload(self) -> None:
+        """Re-read the config file, preserving restart_time (config_holder.go:55-66)."""
+        with self._lock:
+            old = self._config
+            new = _load_config(
+                self._path, old.restart_time, old.standalone_testing, old.debug
+            )
+            self._config = new
+            self.generation += 1

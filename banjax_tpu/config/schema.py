@@ -1,0 +1,224 @@
+"""Config schema: the single YAML file every subsystem reads.
+
+Reference behavior: /root/reference/internal/config.go:17-131 — a ~60-key YAML
+schema whose custom unmarshal step compiles each `regexes_with_rates` entry's
+regex and parses its decision string at load time, so a bad rule fails the
+whole config load (fail fast, before any traffic is touched).
+
+This port keeps the exact YAML key names. The rule-compile step additionally
+feeds the TPU rule compiler (banjax_tpu/matcher/rulec.py) when the TPU matcher
+is enabled; unsupported patterns are reported at load time and fall back
+per-rule to the CPU path.
+
+Extra keys beyond the reference (all optional, default to reference behavior):
+  matcher:              "cpu" (default, Go-semantics reference path) or "tpu"
+  matcher_batch_lines:  device batch size for the TPU matcher
+  matcher_max_line_len: padded line length for the TPU matcher
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import socket
+import time
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from banjax_tpu.decisions.model import Decision, parse_decision
+from banjax_tpu.matcher.re2check import check_re2_compatible
+
+NANOS_PER_SECOND = 1_000_000_000
+
+
+@dataclasses.dataclass
+class RegexWithRate:
+    """One rate-limit rule (config.go:87-131).
+
+    `interval_ns` mirrors Go's time.Duration (int64 nanoseconds) so the
+    fixed-window comparison `ts - start > interval` is bit-identical.
+    """
+
+    rule: str
+    regex_string: str
+    regex: "re.Pattern[str]"
+    interval_ns: int
+    hits_per_interval: int
+    decision: Decision
+    hosts_to_skip: Dict[str, bool] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_yaml_dict(cls, d: Dict[str, Any]) -> "RegexWithRate":
+        regex_string = d.get("regex", "")
+        check_re2_compatible(regex_string)  # reject Python-only constructs RE2 refuses
+        try:
+            regex = re.compile(regex_string)
+        except re.error as e:
+            raise ValueError(f"bad regex {regex_string!r}: {e}") from None
+        # Go: time.Duration(interval_seconds_float * 1e9) — truncation, not round.
+        interval_ns = int(float(d.get("interval", 0)) * NANOS_PER_SECOND)
+        return cls(
+            rule=d.get("rule", ""),
+            regex_string=regex_string,
+            regex=regex,
+            interval_ns=interval_ns,
+            hits_per_interval=int(d.get("hits_per_interval", 0)),
+            decision=parse_decision(d.get("decision", "")),
+            hosts_to_skip=dict(d.get("hosts_to_skip") or {}),
+        )
+
+
+@dataclasses.dataclass
+class Config:
+    """Full banjax config (config.go:17-85). YAML keys unchanged."""
+
+    regexes_with_rates: List[RegexWithRate] = dataclasses.field(default_factory=list)
+    per_site_regexes_with_rates: Dict[str, List[RegexWithRate]] = dataclasses.field(default_factory=dict)
+    server_log_file: str = ""
+    banning_log_file: str = ""
+    iptables_ban_seconds: int = 0
+    iptables_unbanner_seconds: int = 0
+    kafka_brokers: List[str] = dataclasses.field(default_factory=list)
+    kafka_security_protocol: str = ""
+    kafka_ssl_ca: str = ""
+    kafka_ssl_cert: str = ""
+    kafka_ssl_key: str = ""
+    kafka_ssl_key_password: str = ""
+    kafka_command_topic: str = ""
+    kafka_report_topic: str = ""
+    kafka_min_bytes: int = 0
+    kafka_max_bytes: int = 0
+    kafka_max_wait_ms: int = 0
+    kafka_dialer_timeout_seconds: int = 0
+    kafka_dialer_keep_alive_seconds: int = 0
+    per_site_decision_lists: Dict[str, Dict[str, List[str]]] = dataclasses.field(default_factory=dict)
+    global_decision_lists: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    config_version: str = ""
+    standalone_testing: bool = False
+    challenger_bytes: bytes = b""
+    password_page_bytes: bytes = b""
+    password_hashes: Dict[str, str] = dataclasses.field(default_factory=dict)
+    password_protected_paths: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    password_protected_path_exceptions: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    password_hash_roaming: Dict[str, str] = dataclasses.field(default_factory=dict)
+    password_persite_cookie_ttl_seconds: Dict[str, int] = dataclasses.field(default_factory=dict)
+    use_user_agent_in_cookie: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    expiring_decision_ttl_seconds: int = 0
+    block_ip_ttl_seconds: int = 0
+    block_session_ttl_seconds: int = 0
+    sites_to_block_ip_ttl_seconds: Dict[str, int] = dataclasses.field(default_factory=dict)
+    sites_to_block_session_ttl_seconds: Dict[str, int] = dataclasses.field(default_factory=dict)
+    too_many_failed_challenges_interval_seconds: int = 0
+    too_many_failed_challenges_threshold: int = 0
+    password_cookie_ttl_seconds: int = 0
+    sha_inv_cookie_ttl_seconds: int = 0
+    sha_inv_expected_zero_bits: int = 0
+    restart_time: int = 0
+    reload_time: int = 0
+    hostname: str = ""
+    hmac_secret: str = ""
+    gin_log_file: str = ""
+    sitewide_sha_inv_list: Dict[str, str] = dataclasses.field(default_factory=dict)
+    metrics_log_file: str = ""
+    sha_inv_challenge_html: str = ""
+    password_protected_path_html: str = ""
+    debug: bool = False
+    profile: bool = False
+    disable_logging: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    banning_log_file_temp: str = ""
+    disable_kafka: bool = False
+    disable_kafka_writer: bool = False
+    session_cookie_hmac_secret: str = ""
+    session_cookie_ttl_seconds: int = 0
+    session_cookie_not_verify: bool = False
+    sites_to_disable_baskerville: Dict[str, bool] = dataclasses.field(default_factory=dict)
+    sha_inv_path_exceptions: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    dnet: str = ""
+    dnet_to_partition: Dict[str, int] = dataclasses.field(default_factory=dict)
+    per_site_user_agent_decision_lists: Dict[str, Dict[str, List[str]]] = dataclasses.field(default_factory=dict)
+    global_user_agent_decision_lists: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+
+    # --- banjax-tpu extensions (absent from the reference) ---
+    matcher: str = "cpu"  # "cpu" | "tpu" — the Matcher seam flag (BASELINE.json)
+    matcher_batch_lines: int = 16384
+    matcher_max_line_len: int = 256
+
+
+_SCALAR_KEYS = {
+    # yaml key -> attribute (identical names here; kept explicit for clarity)
+    "server_log_file", "banning_log_file", "iptables_ban_seconds",
+    "iptables_unbanner_seconds", "kafka_security_protocol", "kafka_ssl_ca",
+    "kafka_ssl_cert", "kafka_ssl_key", "kafka_ssl_key_password",
+    "kafka_command_topic", "kafka_report_topic", "kafka_min_bytes",
+    "kafka_max_bytes", "kafka_max_wait_ms", "kafka_dialer_timeout_seconds",
+    "kafka_dialer_keep_alive_seconds", "config_version",
+    "expiring_decision_ttl_seconds", "block_ip_ttl_seconds",
+    "block_session_ttl_seconds", "too_many_failed_challenges_interval_seconds",
+    "too_many_failed_challenges_threshold", "password_cookie_ttl_seconds",
+    "sha_inv_cookie_ttl_seconds", "sha_inv_expected_zero_bits", "hmac_secret",
+    "gin_log_file", "metrics_log_file", "sha_inv_challenge_html",
+    "password_protected_path_html", "debug", "profile",
+    "banning_log_file_temp", "disable_kafka", "disable_kafka_writer",
+    "session_cookie_hmac_secret", "session_cookie_ttl_seconds",
+    "session_cookie_not_verify", "dnet", "standalone_testing",
+    "matcher", "matcher_batch_lines", "matcher_max_line_len",
+}
+
+_DICT_OR_LIST_KEYS = {
+    "kafka_brokers", "per_site_decision_lists", "global_decision_lists",
+    "password_hashes", "password_protected_paths",
+    "password_protected_path_exceptions", "password_hash_roaming",
+    "password_persite_cookie_ttl_seconds", "use_user_agent_in_cookie",
+    "sites_to_block_ip_ttl_seconds", "sites_to_block_session_ttl_seconds",
+    "sitewide_sha_inv_list", "disable_logging",
+    "sites_to_disable_baskerville", "sha_inv_path_exceptions",
+    "dnet_to_partition", "per_site_user_agent_decision_lists",
+    "global_user_agent_decision_lists",
+}
+
+
+def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -> Config:
+    """Parse YAML text into a Config, compiling all rate-limit rules.
+
+    Mirrors the yaml.Unmarshal step of config_holder.go:90 with
+    RegexWithRate.UnmarshalYAML (config.go:96-131): any bad regex or bad
+    decision string raises, failing the whole load.
+
+    `standalone_testing_default` reproduces config_holder.go:89-90 ordering:
+    the CLI flag seeds the field *before* unmarshal, so an explicit YAML
+    `standalone_testing:` key wins over the flag.
+    """
+    raw = yaml.safe_load(text) or {}
+    if not isinstance(raw, dict):
+        raise ValueError("config root must be a mapping")
+
+    cfg = Config()
+    cfg.standalone_testing = standalone_testing_default
+
+    for key in _SCALAR_KEYS:
+        if key in raw and raw[key] is not None:
+            setattr(cfg, key, raw[key])
+    for key in _DICT_OR_LIST_KEYS:
+        if key in raw and raw[key] is not None:
+            setattr(cfg, key, raw[key])
+
+    for entry in raw.get("regexes_with_rates") or []:
+        cfg.regexes_with_rates.append(RegexWithRate.from_yaml_dict(entry))
+    for site, entries in (raw.get("per_site_regexes_with_rates") or {}).items():
+        cfg.per_site_regexes_with_rates[site] = [
+            RegexWithRate.from_yaml_dict(e) for e in (entries or [])
+        ]
+
+    return cfg
+
+
+def default_hostname() -> str:
+    try:
+        return socket.gethostname()
+    except OSError:
+        return "unknown-hostname"
+
+
+def now_unix() -> int:
+    return int(time.time())

@@ -1,0 +1,72 @@
+"""Decision model: the four-valued verdict every subsystem speaks.
+
+Reference behavior: /root/reference/internal/decision.go:20-85 — an ordered
+enum Allow < Challenge < NginxBlock < IptablesBlock whose ordering implements
+the "never downgrade severity" rule used by the dynamic decision lists, plus
+a two-valued FailAction used by the sitewide SHA-inv challenge list.
+
+TPU note: the integer severity ordering is deliberate — on the device side the
+decision merge becomes a `jnp.maximum` over int32 lanes (see
+banjax_tpu/matcher/windows.py), so the enum values here are the on-device
+encoding as well.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Decision(enum.IntEnum):
+    """Severity-ordered verdict. 0 is reserved as "no decision" on device."""
+
+    ALLOW = 1
+    CHALLENGE = 2
+    NGINX_BLOCK = 3
+    IPTABLES_BLOCK = 4
+
+    def __str__(self) -> str:  # matches decision.go:45-58 String()
+        return _DECISION_TO_STRING[self]
+
+
+_DECISION_TO_STRING = {
+    Decision.ALLOW: "Allow",
+    Decision.CHALLENGE: "Challenge",
+    Decision.NGINX_BLOCK: "NginxBlock",
+    Decision.IPTABLES_BLOCK: "IptablesBlock",
+}
+
+_STRING_TO_DECISION = {
+    "allow": Decision.ALLOW,
+    "challenge": Decision.CHALLENGE,
+    "nginx_block": Decision.NGINX_BLOCK,
+    "iptables_block": Decision.IPTABLES_BLOCK,
+}
+
+
+def parse_decision(s: str) -> Decision:
+    """Parse a config-file decision string (decision.go:30-43)."""
+    try:
+        return _STRING_TO_DECISION[s]
+    except KeyError:
+        raise ValueError(f"invalid decision: {s}") from None
+
+
+class FailAction(enum.IntEnum):
+    """What a sitewide SHA-inv challenge does on repeated failure
+    (decision.go:68-85)."""
+
+    BLOCK = 1
+    NO_BLOCK = 2
+
+
+_STRING_TO_FAIL_ACTION = {
+    "block": FailAction.BLOCK,
+    "no_block": FailAction.NO_BLOCK,
+}
+
+
+def parse_fail_action(s: str) -> FailAction:
+    try:
+        return _STRING_TO_FAIL_ACTION[s]
+    except KeyError:
+        raise ValueError(f"invalid fail action: {s}") from None

@@ -1,0 +1,85 @@
+"""RE2-dialect compatibility check for configured regexes.
+
+The reference compiles rules with Go's regexp package, which implements RE2:
+no lookaround, no backreferences, guaranteed-linear matching. This framework
+compiles patterns with Python `re` for the host path (a superset), so to keep
+the two implementations accepting the same config files we reject the
+Python-only constructs RE2 would refuse at load time
+(reference: config.go:110 regexp.Compile failing the whole config load).
+
+The TPU rule compiler (banjax_tpu/matcher/rulec.py) enforces the same subset
+structurally — it simply has no way to express lookaround or backrefs in an
+NFA transition tensor.
+"""
+
+from __future__ import annotations
+
+import re
+
+# Constructs Python re accepts but RE2 rejects.
+_RE2_INCOMPATIBLE = re.compile(
+    r"""
+    \(\?=         # lookahead
+  | \(\?!         # negative lookahead
+  | \(\?<=        # lookbehind
+  | \(\?<!        # negative lookbehind
+  | \(\?\#        # comment group
+  | \(\?P=        # named backreference
+  | \(\?\(        # conditional group
+    """,
+    re.VERBOSE,
+)
+
+_BACKREF = re.compile(r"\\[1-9]")
+
+
+def check_re2_compatible(pattern: str) -> None:
+    """Raise ValueError if `pattern` uses constructs RE2 (Go regexp) rejects.
+
+    We scan the raw pattern text outside character classes; this is a
+    conservative syntactic filter, not a full parser — rulec.py does the
+    full parse for the device path.
+    """
+    # strip character classes and escaped chars before scanning for groups,
+    # so that e.g. [(?=] or \( are not false positives
+    stripped = _strip_classes_and_escapes(pattern)
+    m = _RE2_INCOMPATIBLE.search(stripped)
+    if m is not None:
+        raise ValueError(
+            f"regex {pattern!r} uses {m.group(0)!r}, which Go's RE2 engine does not support"
+        )
+    if _BACKREF.search(stripped):
+        raise ValueError(
+            f"regex {pattern!r} uses a backreference, which Go's RE2 engine does not support"
+        )
+
+
+def _strip_classes_and_escapes(pattern: str) -> str:
+    out = []
+    i = 0
+    n = len(pattern)
+    while i < n:
+        c = pattern[i]
+        if c == "\\" and i + 1 < n:
+            nxt = pattern[i + 1]
+            if nxt.isdigit():
+                out.append(c)
+                out.append(nxt)  # keep backrefs visible to the scanner
+            i += 2
+            continue
+        if c == "[":
+            # skip the whole class, honoring leading ^] and escapes
+            i += 1
+            if i < n and pattern[i] == "^":
+                i += 1
+            if i < n and pattern[i] == "]":
+                i += 1
+            while i < n and pattern[i] != "]":
+                if pattern[i] == "\\":
+                    i += 1
+                i += 1
+            i += 1  # closing ]
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)

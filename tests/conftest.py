@@ -1,0 +1,18 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+The driver tests multi-chip sharding without hardware by running JAX on the
+host platform with 8 virtual devices; real-TPU benchmarking happens outside
+pytest (bench.py).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,105 @@
+"""Challenge-cookie crypto (reference: internal/challenge_response_test.go)."""
+
+import base64
+import time
+
+import pytest
+
+from banjax_tpu.crypto.challenge import (
+    CookieError,
+    compute_hmac,
+    count_zero_bits_from_left,
+    new_challenge_cookie,
+    parse_cookie,
+    solve_challenge_for_testing,
+    validate_password_cookie,
+    validate_sha_inv_cookie,
+)
+import hashlib
+
+
+def test_count_zero_bits():
+    assert count_zero_bits_from_left(b"\x80") == 0
+    assert count_zero_bits_from_left(b"\x40") == 1
+    assert count_zero_bits_from_left(b"\x01") == 7
+    assert count_zero_bits_from_left(b"\x00\x80") == 8
+    assert count_zero_bits_from_left(b"\x00\x00") == 16
+    assert count_zero_bits_from_left(b"") == 0
+
+
+def test_hmac_is_deterministic_and_bound():
+    t = int(time.time()) + 100
+    h1 = compute_hmac("secret", t, "1.2.3.4")
+    h2 = compute_hmac("secret", t, "1.2.3.4")
+    assert h1 == h2
+    assert len(h1) == 20
+    assert compute_hmac("secret", t, "5.6.7.8") != h1
+    assert compute_hmac("other", t, "1.2.3.4") != h1
+    assert compute_hmac("secret", t + 1, "1.2.3.4") != h1
+
+
+def test_cookie_roundtrip_format():
+    cookie = new_challenge_cookie("secret", 100, "1.2.3.4")
+    hmac_b, solution, expiry = parse_cookie(cookie)
+    assert len(hmac_b) == 20
+    assert solution == b"\x00" * 32
+    assert len(expiry) == 8
+
+
+def test_parse_cookie_bad_base64_and_length():
+    with pytest.raises(CookieError):
+        parse_cookie("!!!notbase64!!!")
+    with pytest.raises(CookieError):
+        parse_cookie(base64.standard_b64encode(b"too short").decode())
+
+
+def test_parse_cookie_plus_to_space_workaround():
+    cookie = new_challenge_cookie("secret", 100, "1.2.3.4")
+    mangled = cookie.replace("+", " ")
+    # even if the proxy mangled '+' into ' ', parsing succeeds
+    parse_cookie(mangled)
+
+
+def test_sha_inv_cookie_full_lifecycle():
+    now = time.time()
+    fresh = new_challenge_cookie("secret", 100, "1.2.3.4")
+    # unsolved cookie fails at difficulty 10 (overwhelmingly likely)
+    with pytest.raises(CookieError):
+        validate_sha_inv_cookie("secret", fresh, now, "1.2.3.4", 10)
+    solved = solve_challenge_for_testing(fresh, zero_bits=10)
+    validate_sha_inv_cookie("secret", solved, now, "1.2.3.4", 10)
+    # wrong binding fails the hmac
+    with pytest.raises(CookieError):
+        validate_sha_inv_cookie("secret", solved, now, "5.6.7.8", 10)
+    # wrong secret fails the hmac
+    with pytest.raises(CookieError):
+        validate_sha_inv_cookie("other", solved, now, "1.2.3.4", 10)
+    # higher difficulty than solved-for (54 bits) is essentially impossible
+    with pytest.raises(CookieError):
+        validate_sha_inv_cookie("secret", solved, now, "1.2.3.4", 54)
+
+
+def test_expired_cookie_rejected():
+    cookie = new_challenge_cookie("secret", -10, "1.2.3.4")
+    with pytest.raises(CookieError):
+        validate_sha_inv_cookie("secret", cookie, time.time(), "1.2.3.4", 0)
+
+
+def test_password_cookie_lifecycle():
+    hashed_password = hashlib.sha256(b"password").digest()
+    now = time.time()
+    fresh = new_challenge_cookie("secret", 100, "1.2.3.4")
+    hmac_b, _, expiry = parse_cookie(fresh)
+    # build the solution exactly like the client JS does:
+    # solution = sha256(hmac ‖ sha256(password))
+    solution = hashlib.sha256(hmac_b + hashed_password).digest()
+    solved = base64.standard_b64encode(hmac_b + solution + expiry).decode()
+    validate_password_cookie("secret", solved, now, "1.2.3.4", hashed_password)
+    # wrong password hash rejected
+    with pytest.raises(CookieError):
+        validate_password_cookie(
+            "secret", solved, now, "1.2.3.4", hashlib.sha256(b"wrong").digest()
+        )
+    # unsolved cookie rejected
+    with pytest.raises(CookieError):
+        validate_password_cookie("secret", fresh, now, "1.2.3.4", hashed_password)

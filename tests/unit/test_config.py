@@ -1,0 +1,150 @@
+"""Config schema parsing (reference: internal/config_test.go, config.go:87-131)."""
+
+import pytest
+
+from banjax_tpu.config.schema import Config, config_from_yaml_text
+from banjax_tpu.decisions.model import Decision
+
+
+REGEX_RULE_YAML = """
+regexes_with_rates:
+  - decision: nginx_block
+    hits_per_interval: 800
+    interval: 30
+    regex: .*
+    rule: "All sites/methods: 800 req/30 sec"
+    hosts_to_skip:
+      example.com: true
+  - decision: challenge
+    hits_per_interval: 45
+    interval: 60
+    regex: "^POST .*"
+    rule: "All sites/POST: 45 req/60 sec"
+"""
+
+
+def test_regex_with_rate_unmarshal():
+    cfg = config_from_yaml_text(REGEX_RULE_YAML)
+    assert len(cfg.regexes_with_rates) == 2
+    r0 = cfg.regexes_with_rates[0]
+    assert r0.rule == "All sites/methods: 800 req/30 sec"
+    assert r0.decision is Decision.NGINX_BLOCK
+    assert r0.hits_per_interval == 800
+    assert r0.interval_ns == 30 * 1_000_000_000
+    assert r0.hosts_to_skip == {"example.com": True}
+    assert r0.regex.search("anything at all")
+
+    r1 = cfg.regexes_with_rates[1]
+    assert r1.regex.search("POST /login HTTP/1.1")
+    assert not r1.regex.search("GET /login HTTP/1.1")
+
+
+def test_fractional_interval_truncates_like_go():
+    cfg = config_from_yaml_text(
+        """
+regexes_with_rates:
+  - decision: allow
+    hits_per_interval: 1
+    interval: 0.5
+    regex: x
+    rule: r
+"""
+    )
+    assert cfg.regexes_with_rates[0].interval_ns == 500_000_000
+
+
+def test_bad_regex_fails_load():
+    with pytest.raises(ValueError):
+        config_from_yaml_text(
+            """
+regexes_with_rates:
+  - decision: allow
+    hits_per_interval: 1
+    interval: 1
+    regex: "(?invalid"
+    rule: bad
+"""
+        )
+
+
+def test_bad_decision_fails_load():
+    with pytest.raises(ValueError):
+        config_from_yaml_text(
+            """
+regexes_with_rates:
+  - decision: obliterate
+    hits_per_interval: 1
+    interval: 1
+    regex: x
+    rule: bad
+"""
+        )
+
+
+def test_per_site_regexes():
+    cfg = config_from_yaml_text(
+        """
+per_site_regexes_with_rates:
+  localhost:
+    - decision: nginx_block
+      hits_per_interval: 0
+      interval: 1
+      regex: .*blockme.*
+      rule: "instant block"
+"""
+    )
+    assert list(cfg.per_site_regexes_with_rates) == ["localhost"]
+    assert cfg.per_site_regexes_with_rates["localhost"][0].decision is Decision.NGINX_BLOCK
+
+
+def test_scalar_and_map_keys():
+    cfg = config_from_yaml_text(
+        """
+config_version: 2021-03-22_00:00:00
+expiring_decision_ttl_seconds: 300
+iptables_ban_seconds: 300
+kafka_brokers:
+  - localhost:9094
+sha_inv_expected_zero_bits: 10
+sitewide_sha_inv_list:
+  example.com: block
+use_user_agent_in_cookie:
+  localhost: true
+"""
+    )
+    assert cfg.expiring_decision_ttl_seconds == 300
+    assert cfg.kafka_brokers == ["localhost:9094"]
+    assert cfg.sha_inv_expected_zero_bits == 10
+    assert cfg.sitewide_sha_inv_list == {"example.com": "block"}
+    assert cfg.use_user_agent_in_cookie == {"localhost": True}
+    # defaults for untouched keys
+    assert cfg.matcher == "cpu"
+    assert cfg.debug is False
+
+
+def test_re2_incompatible_constructs_rejected():
+    # Go's RE2 rejects lookaround and backreferences; so must we
+    for bad in [r"(?=bot).*crawl", r"(a)\1", r"(?<!x)y", r"(?P<g>a)(?P=g)"]:
+        with pytest.raises(ValueError):
+            config_from_yaml_text(
+                f"""
+regexes_with_rates:
+  - decision: allow
+    hits_per_interval: 1
+    interval: 1
+    regex: '{bad}'
+    rule: bad
+"""
+            )
+    # but the same tokens inside a character class are literal and fine
+    cfg = config_from_yaml_text(
+        """
+regexes_with_rates:
+  - decision: allow
+    hits_per_interval: 1
+    interval: 1
+    regex: '[(?=]+x'
+    rule: ok
+"""
+    )
+    assert cfg.regexes_with_rates[0].regex.search("(?=x")

@@ -1,0 +1,37 @@
+"""Decision enum semantics (reference: internal/decision.go:20-85)."""
+
+import pytest
+
+from banjax_tpu.decisions.model import (
+    Decision,
+    FailAction,
+    parse_decision,
+    parse_fail_action,
+)
+
+
+def test_severity_ordering():
+    assert Decision.ALLOW < Decision.CHALLENGE < Decision.NGINX_BLOCK < Decision.IPTABLES_BLOCK
+
+
+def test_parse_decision():
+    assert parse_decision("allow") is Decision.ALLOW
+    assert parse_decision("challenge") is Decision.CHALLENGE
+    assert parse_decision("nginx_block") is Decision.NGINX_BLOCK
+    assert parse_decision("iptables_block") is Decision.IPTABLES_BLOCK
+    with pytest.raises(ValueError):
+        parse_decision("nonsense")
+
+
+def test_decision_string():
+    assert str(Decision.ALLOW) == "Allow"
+    assert str(Decision.CHALLENGE) == "Challenge"
+    assert str(Decision.NGINX_BLOCK) == "NginxBlock"
+    assert str(Decision.IPTABLES_BLOCK) == "IptablesBlock"
+
+
+def test_parse_fail_action():
+    assert parse_fail_action("block") is FailAction.BLOCK
+    assert parse_fail_action("no_block") is FailAction.NO_BLOCK
+    with pytest.raises(ValueError):
+        parse_fail_action("whatever")

@@ -1,0 +1,122 @@
+"""Dynamic expiring decision lists (reference: internal/decision.go:379-604)."""
+
+import time
+
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.model import Decision
+
+
+def make_lists():
+    return DynamicDecisionLists(start_sweeper=False)
+
+
+def test_update_and_check():
+    lists = make_lists()
+    expires = time.time() + 60
+    lists.update("1.2.3.4", expires, Decision.CHALLENGE, False, "example.com")
+    ed, ok = lists.check("", "1.2.3.4")
+    assert ok
+    assert ed.decision is Decision.CHALLENGE
+    assert ed.domain == "example.com"
+
+
+def test_never_downgrade_severity():
+    lists = make_lists()
+    expires = time.time() + 60
+    lists.update("1.2.3.4", expires, Decision.NGINX_BLOCK, False, "a.com")
+    lists.update("1.2.3.4", expires, Decision.CHALLENGE, False, "b.com")
+    ed, ok = lists.check("", "1.2.3.4")
+    assert ok
+    assert ed.decision is Decision.NGINX_BLOCK
+    assert ed.domain == "a.com"  # the downgrade attempt was a no-op
+
+    # an upgrade is applied
+    lists.update("1.2.3.4", expires, Decision.IPTABLES_BLOCK, False, "c.com")
+    ed, _ = lists.check("", "1.2.3.4")
+    assert ed.decision is Decision.IPTABLES_BLOCK
+
+
+def test_equal_severity_is_noop():
+    lists = make_lists()
+    e1 = time.time() + 60
+    e2 = time.time() + 3600
+    lists.update("1.2.3.4", e1, Decision.CHALLENGE, False, "a.com")
+    lists.update("1.2.3.4", e2, Decision.CHALLENGE, False, "b.com")
+    ed, _ = lists.check("", "1.2.3.4")
+    assert ed.expires == e1  # newDecision <= existing → no-op (decision.go:417)
+
+
+def test_lazy_expiry_on_read():
+    lists = make_lists()
+    lists.update("1.2.3.4", time.time() - 1, Decision.CHALLENGE, False, "a.com")
+    ed, ok = lists.check("", "1.2.3.4")
+    assert not ok
+    # second read: entry was deleted
+    ed, ok = lists.check("", "1.2.3.4")
+    assert not ok and ed is None
+
+
+def test_session_id_priority():
+    lists = make_lists()
+    expires = time.time() + 60
+    lists.update("1.2.3.4", expires, Decision.CHALLENGE, False, "a.com")
+    lists.update_by_session_id("1.2.3.4", "sess-1", expires, Decision.NGINX_BLOCK, True, "a.com")
+    ed, ok = lists.check("sess-1", "1.2.3.4")
+    assert ok
+    assert ed.decision is Decision.NGINX_BLOCK  # session hit wins over IP
+
+    ed, ok = lists.check("other-sess", "1.2.3.4")
+    assert ok
+    assert ed.decision is Decision.CHALLENGE  # unknown session falls back to IP
+
+
+def test_expired_session_does_not_fall_through():
+    # quirk: a found-but-expired session entry returns ok=False without
+    # checking the IP map (decision.go:487 early return)
+    lists = make_lists()
+    lists.update("1.2.3.4", time.time() + 60, Decision.CHALLENGE, False, "a.com")
+    lists.update_by_session_id("1.2.3.4", "sess-1", time.time() - 1, Decision.NGINX_BLOCK, False, "a.com")
+    ed, ok = lists.check("sess-1", "1.2.3.4")
+    assert not ok
+
+
+def test_check_by_domain():
+    lists = make_lists()
+    expires = time.time() + 60
+    lists.update("1.1.1.1", expires, Decision.ALLOW, False, "a.com")
+    lists.update("2.2.2.2", expires, Decision.CHALLENGE, False, "a.com")
+    lists.update("3.3.3.3", expires, Decision.IPTABLES_BLOCK, True, "a.com")
+    lists.update("4.4.4.4", expires, Decision.NGINX_BLOCK, False, "b.com")
+    lists.update_by_session_id("5.5.5.5", "sess-9", expires, Decision.CHALLENGE, True, "a.com")
+
+    entries = lists.check_by_domain("a.com")
+    keys = {e.ip_or_session_id for e in entries}
+    # Allow entries are excluded (severity >= Challenge only)
+    assert keys == {"2.2.2.2", "3.3.3.3", "sess-9"}
+    bask = {e.ip_or_session_id: e.from_baskerville for e in entries}
+    assert bask["3.3.3.3"] is True and bask["2.2.2.2"] is False
+
+
+def test_remove_and_clear():
+    lists = make_lists()
+    expires = time.time() + 60
+    lists.update("1.1.1.1", expires, Decision.CHALLENGE, False, "a.com")
+    lists.remove_by_ip("1.1.1.1")
+    assert lists.check("", "1.1.1.1") == (None, False)
+
+    lists.update("2.2.2.2", expires, Decision.CHALLENGE, False, "a.com")
+    lists.update_by_session_id("2.2.2.2", "s", expires, Decision.CHALLENGE, False, "a.com")
+    lists.clear()
+    assert lists.check("s", "2.2.2.2") == (None, False)
+
+
+def test_metrics():
+    lists = make_lists()
+    expires = time.time() + 60
+    lists.update("1.1.1.1", expires, Decision.CHALLENGE, False, "a.com")
+    lists.update("2.2.2.2", expires, Decision.NGINX_BLOCK, False, "a.com")
+    lists.update("3.3.3.3", expires, Decision.IPTABLES_BLOCK, False, "a.com")
+    lists.update("4.4.4.4", expires, Decision.ALLOW, False, "a.com")
+    challenges, blocks = lists.metrics()
+    assert challenges == 1
+    assert blocks == 2

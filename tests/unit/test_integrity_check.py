@@ -1,0 +1,131 @@
+"""Bot-score integrity check (reference: internal/integrity_check_test.go)."""
+
+import base64
+import json
+
+from banjax_tpu.crypto.integrity import (
+    IntegrityCheckPayload,
+    calc_bot_score,
+    calc_bot_score_from_cookie,
+    calc_fingerprint,
+)
+
+
+def human_payload() -> IntegrityCheckPayload:
+    return IntegrityCheckPayload(
+        webdriver=False,
+        has_plugins=True,
+        gpu_renderer="ANGLE (Apple, Apple M1, OpenGL 4.1)",
+        cpu=8,
+        memory=8,
+        screen_width=2560,
+        screen_height=1440,
+        window_inner_width=1200,
+        window_inner_height=900,
+        color_depth=30,
+        lang_length=2,
+        language="en-US",
+        languages=["en-US", "en"],
+        timezone="Europe/Berlin",
+        platform="MacIntel",
+        canvas_fp="abc",
+        webgl_fp="def",
+        math_fp="ghi",
+        webcam=True,
+    )
+
+
+def test_human_scores_zero():
+    score, top_factor, wrapper = calc_bot_score(human_payload())
+    assert score == 0.0
+    assert top_factor == ""
+    assert wrapper.hash != ""
+
+
+def test_webdriver_dominates():
+    p = human_payload()
+    p.webdriver = True
+    score, top_factor, _ = calc_bot_score(p)
+    assert top_factor == "webdriver"
+    assert 0 < score < 1
+
+
+def test_headless_stack_scores_high():
+    p = IntegrityCheckPayload(
+        webdriver=True,
+        has_plugins=False,
+        gpu_renderer="Google SwiftShader",
+        cpu=1,
+        memory=1,
+        screen_width=800,
+        screen_height=600,
+        window_inner_width=800,
+        window_inner_height=600,
+        color_depth=16,
+        lang_length=0,
+    )
+    score, top_factor, _ = calc_bot_score(p)
+    assert score == 1.0  # all 31/31 factors fire
+    assert top_factor == "webdriver"
+
+
+def test_empty_payload_scores_one():
+    score, top_factor, _ = calc_bot_score_from_cookie("")
+    assert score == 1.0
+    assert top_factor == "no_payload"
+
+
+def test_invalid_payload_scores_one():
+    score, top_factor, _ = calc_bot_score_from_cookie("not-base64!!")
+    assert score == 1.0
+    assert top_factor == "err_payload"
+    score, top_factor, _ = calc_bot_score_from_cookie(
+        base64.standard_b64encode(b"not json").decode()
+    )
+    assert top_factor == "err_payload"
+
+
+def test_cookie_roundtrip():
+    payload_json = json.dumps(human_payload().to_json_dict())
+    b64 = base64.standard_b64encode(payload_json.encode()).decode()
+    score, top_factor, wrapper = calc_bot_score_from_cookie(b64)
+    assert score == 0.0
+    assert wrapper.hash == calc_fingerprint(human_payload())
+
+
+def test_fingerprint_is_stable_and_sensitive():
+    fp1 = calc_fingerprint(human_payload())
+    fp2 = calc_fingerprint(human_payload())
+    assert fp1 == fp2
+    p = human_payload()
+    p.canvas_fp = "changed"
+    assert calc_fingerprint(p) != fp1
+
+
+def test_software_renderer_detection():
+    for renderer in ("Google SwiftShader", "llvmpipe (LLVM 12.0.0)", "Mesa OffScreen"):
+        p = human_payload()
+        p.gpu_renderer = renderer
+        score, _, _ = calc_bot_score(p)
+        assert score > 0
+
+
+def test_go_json_type_mismatches_score_one():
+    # Go's json.Unmarshal rejects these; we must too (score 1.0, err_payload)
+    for doc in ['{"webdriver": "false"}', '{"cpu": "8"}', '{"cpu": 1.5}',
+                '{"screen": "x"}', '{"languages": [1]}', '[]', '"x"']:
+        b64 = base64.standard_b64encode(doc.encode()).decode()
+        score, top, _ = calc_bot_score_from_cookie(b64)
+        assert (score, top) == (1.0, "err_payload"), doc
+
+
+def test_json_null_is_zero_payload():
+    # Go: unmarshal of null is a no-op -> zero payload gets scored normally
+    b64 = base64.standard_b64encode(b"null").decode()
+    score, top, _ = calc_bot_score_from_cookie(b64)
+    assert top != "err_payload"
+    assert 0 < score <= 1.0
+    # field-level null keeps the zero value, other fields still checked
+    b64 = base64.standard_b64encode(b'{"cpu": null, "webdriver": true}').decode()
+    score, top, _ = calc_bot_score_from_cookie(b64)
+    assert top == "webdriver"

@@ -1,0 +1,95 @@
+"""Password-protected path classification (reference: internal/config_test.go:35-81,
+password_protected_path.go)."""
+
+import hashlib
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths, PathType
+
+
+YAML = """
+password_protected_paths:
+  "example.com":
+    - wp-admin
+    - /secret/
+password_protected_path_exceptions:
+  "example.com":
+    - wp-admin/admin-ajax.php
+password_hashes:
+  "example.com": 5e884898da28047151d0e56f8dc6292773603d0d6aabbdd62a11ef721d1542d8
+password_hash_roaming:
+  sub.example.com: example.com
+"""
+
+
+def make_paths():
+    return PasswordProtectedPaths(config_from_yaml_text(YAML))
+
+
+def test_classify_protected_prefix():
+    paths = make_paths()
+    assert paths.classify_path("example.com", "/wp-admin") is PathType.PASSWORD_PROTECTED
+    assert paths.classify_path("example.com", "/wp-admin/post.php") is PathType.PASSWORD_PROTECTED
+    assert paths.classify_path("example.com", "/secret/x") is PathType.PASSWORD_PROTECTED
+
+
+def test_classify_exception_beats_protected():
+    paths = make_paths()
+    assert (
+        paths.classify_path("example.com", "/wp-admin/admin-ajax.php")
+        is PathType.PASSWORD_PROTECTED_EXCEPTION
+    )
+
+
+def test_classify_unprotected():
+    paths = make_paths()
+    assert paths.classify_path("example.com", "/index.html") is PathType.NOT_PASSWORD_PROTECTED
+    assert paths.classify_path("other.com", "/wp-admin") is PathType.NOT_PASSWORD_PROTECTED
+
+
+def test_password_hash_decoding():
+    paths = make_paths()
+    h, ok = paths.get_password_hash("example.com")
+    assert ok
+    assert h == hashlib.sha256(b"password").digest()
+    _, ok = paths.get_password_hash("other.com")
+    assert not ok
+
+
+def test_roaming_hash_inherits_root():
+    paths = make_paths()
+    h, ok = paths.get_roaming_password_hash("sub.example.com")
+    assert ok
+    assert h == hashlib.sha256(b"password").digest()
+    # roaming flips the root's expand-cookie-domain flag
+    flag, ok = paths.get_expand_cookie_domain("example.com")
+    assert ok and flag
+    _, ok = paths.get_expand_cookie_domain("sub.example.com")
+    assert not ok
+
+
+def test_is_exception_exact_only():
+    paths = make_paths()
+    assert paths.is_exception("example.com", "/wp-admin/admin-ajax.php")
+    assert not paths.is_exception("example.com", "/wp-admin/admin-ajax.php/extra")
+    assert not paths.is_exception("other.com", "/wp-admin/admin-ajax.php")
+
+
+def test_bad_hash_raises():
+    with pytest.raises(ValueError):
+        PasswordProtectedPaths(
+            config_from_yaml_text(
+                """
+password_hashes:
+  "example.com": not-hex
+"""
+            )
+        )
+
+
+def test_hot_reload():
+    paths = make_paths()
+    paths.update_from_config(config_from_yaml_text("password_protected_paths: {}"))
+    assert paths.classify_path("example.com", "/wp-admin") is PathType.NOT_PASSWORD_PROTECTED

@@ -1,0 +1,126 @@
+"""Fixed-window rate-limit semantics (reference: internal/rate_limit.go)."""
+
+import re
+
+from banjax_tpu.config.schema import Config, RegexWithRate
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.decisions.rate_limit import (
+    FailedChallengeRateLimitStates,
+    RateLimitMatchType,
+    RegexRateLimitStates,
+)
+
+NS = 1_000_000_000
+
+
+def make_rule(interval_s=10, hits=3, name="r"):
+    return RegexWithRate(
+        rule=name,
+        regex_string=".*",
+        regex=re.compile(".*"),
+        interval_ns=interval_s * NS,
+        hits_per_interval=hits,
+        decision=Decision.CHALLENGE,
+    )
+
+
+def test_first_hit_new_ip():
+    states = RegexRateLimitStates()
+    seen, result = states.apply("1.2.3.4", make_rule(), 100 * NS)
+    assert not seen
+    assert not result.exceeded
+    assert len(states) == 1
+
+
+def test_inside_interval_counts_up_and_exceeds():
+    states = RegexRateLimitStates()
+    rule = make_rule(interval_s=10, hits=3)
+    t0 = 100 * NS
+    states.apply("ip", rule, t0)
+    for i in range(1, 3):
+        seen, result = states.apply("ip", rule, t0 + i)
+        assert seen
+        assert result.match_type is RateLimitMatchType.INSIDE_INTERVAL
+        assert not result.exceeded
+    # 4th hit: num_hits=4 > 3 → exceeded
+    _, result = states.apply("ip", rule, t0 + 3)
+    assert result.exceeded
+
+
+def test_window_restart_outside_interval():
+    states = RegexRateLimitStates()
+    rule = make_rule(interval_s=10, hits=3)
+    t0 = 100 * NS
+    states.apply("ip", rule, t0)
+    # strictly greater than interval → restart
+    _, result = states.apply("ip", rule, t0 + 10 * NS + 1)
+    assert result.match_type is RateLimitMatchType.OUTSIDE_INTERVAL
+    assert not result.exceeded
+    # exactly the interval boundary → still inside
+    states2 = RegexRateLimitStates()
+    states2.apply("ip", rule, t0)
+    _, result = states2.apply("ip", rule, t0 + 10 * NS)
+    assert result.match_type is RateLimitMatchType.INSIDE_INTERVAL
+
+
+def test_reset_to_zero_on_exceed_quirk():
+    # After an exceed, hits reset to 0, so the next hits count 1,2,...
+    states = RegexRateLimitStates()
+    rule = make_rule(interval_s=1000, hits=2)
+    t = 100 * NS
+    states.apply("ip", rule, t)          # hits=1
+    states.apply("ip", rule, t + 1)      # hits=2
+    _, r = states.apply("ip", rule, t + 2)  # hits=3 > 2 → exceeded, reset to 0
+    assert r.exceeded
+    _, r = states.apply("ip", rule, t + 3)  # hits=1
+    assert not r.exceeded
+    _, r = states.apply("ip", rule, t + 4)  # hits=2
+    assert not r.exceeded
+    _, r = states.apply("ip", rule, t + 5)  # hits=3 → exceeded again
+    assert r.exceeded
+
+
+def test_new_rule_for_seen_ip_is_first_time():
+    states = RegexRateLimitStates()
+    t = 100 * NS
+    states.apply("ip", make_rule(name="a"), t)
+    seen, result = states.apply("ip", make_rule(name="b"), t)
+    assert seen
+    assert result.match_type is RateLimitMatchType.FIRST_TIME
+
+
+def test_zero_hits_per_interval_instant_exceed():
+    # rules like "instant ban" use hits_per_interval: 0 → every hit exceeds
+    states = RegexRateLimitStates()
+    rule = make_rule(interval_s=1, hits=0)
+    _, r = states.apply("ip", rule, 100 * NS)
+    assert r.exceeded
+    _, r = states.apply("ip", rule, 101 * NS)
+    assert r.exceeded
+
+
+def test_get_returns_deep_copy():
+    states = RegexRateLimitStates()
+    rule = make_rule()
+    states.apply("ip", rule, 100 * NS)
+    copy1, ok = states.get("ip")
+    assert ok
+    copy1[rule.rule].num_hits = 999
+    copy2, _ = states.get("ip")
+    assert copy2[rule.rule].num_hits == 1
+    _, ok = states.get("nope")
+    assert not ok
+
+
+def test_failed_challenge_states():
+    states = FailedChallengeRateLimitStates()
+    config = Config(
+        too_many_failed_challenges_interval_seconds=1000,
+        too_many_failed_challenges_threshold=3,
+    )
+    for _ in range(3):
+        r = states.apply("ip", config)
+        assert not r.exceeded
+    r = states.apply("ip", config)
+    assert r.exceeded
+    assert len(states) == 1

@@ -1,0 +1,51 @@
+"""Session cookie (reference: internal/session_cookie_test.go)."""
+
+import time
+
+import pytest
+
+from banjax_tpu.crypto.session import (
+    SESSION_ID_LENGTH,
+    SessionCookieError,
+    new_session_cookie,
+    validate_session_cookie,
+)
+
+
+def test_create_and_validate():
+    cookie = new_session_cookie("some_secret", 3600, "1.2.3.4")
+    validate_session_cookie(cookie, "some_secret", time.time(), "1.2.3.4")
+
+
+def test_wrong_ip_rejected():
+    cookie = new_session_cookie("some_secret", 3600, "1.2.3.4")
+    with pytest.raises(SessionCookieError):
+        validate_session_cookie(cookie, "some_secret", time.time(), "5.6.7.8")
+
+
+def test_wrong_secret_rejected():
+    cookie = new_session_cookie("some_secret", 3600, "1.2.3.4")
+    with pytest.raises(SessionCookieError):
+        validate_session_cookie(cookie, "other_secret", time.time(), "1.2.3.4")
+
+
+def test_expired_rejected():
+    cookie = new_session_cookie("some_secret", -10, "1.2.3.4")
+    with pytest.raises(SessionCookieError):
+        validate_session_cookie(cookie, "some_secret", time.time(), "1.2.3.4")
+
+
+def test_garbage_rejected():
+    with pytest.raises(SessionCookieError):
+        validate_session_cookie("!!!", "s", time.time(), "1.2.3.4")
+    with pytest.raises(SessionCookieError):
+        validate_session_cookie("dG9vc2hvcnQ=", "s", time.time(), "1.2.3.4")
+
+
+def test_creation_speed():
+    # reference prints the time for 1000 cookies (session_cookie_test.go:17-27)
+    start = time.monotonic()
+    for _ in range(1000):
+        new_session_cookie("some_secret", 3600, "1.2.3.4")
+    elapsed = time.monotonic() - start
+    assert elapsed < 5.0

@@ -1,0 +1,111 @@
+"""Static decision lists: exact IP + CIDR matching (reference: internal/decision.go:88-374)."""
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.model import Decision, FailAction
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+
+
+YAML = """
+global_decision_lists:
+  allow:
+    - 20.20.20.20
+    - 10.0.0.0/8
+  iptables_block:
+    - 30.40.50.60
+  nginx_block:
+    - 70.80.90.100
+    - 192.168.0.0/16
+  challenge:
+    - 8.8.8.8
+per_site_decision_lists:
+  example.com:
+    allow:
+      - 90.90.90.90
+    challenge:
+      - 91.91.91.91
+      - 172.16.0.0/12
+sitewide_sha_inv_list:
+  example.com: block
+  foobar.com: no_block
+"""
+
+
+def make_lists():
+    return StaticDecisionLists(config_from_yaml_text(YAML))
+
+
+def test_global_exact():
+    lists = make_lists()
+    assert lists.check_global("20.20.20.20") == (Decision.ALLOW, True)
+    assert lists.check_global("30.40.50.60") == (Decision.IPTABLES_BLOCK, True)
+    assert lists.check_global("8.8.8.8") == (Decision.CHALLENGE, True)
+    assert lists.check_global("1.1.1.1") == (None, False)
+
+
+def test_global_cidr():
+    lists = make_lists()
+    assert lists.check_global("10.1.2.3") == (Decision.ALLOW, True)
+    assert lists.check_global("192.168.55.1") == (Decision.NGINX_BLOCK, True)
+
+
+def test_per_site():
+    lists = make_lists()
+    assert lists.check_per_site("example.com", "90.90.90.90") == (Decision.ALLOW, True)
+    assert lists.check_per_site("example.com", "91.91.91.91") == (Decision.CHALLENGE, True)
+    assert lists.check_per_site("example.com", "172.20.1.1") == (Decision.CHALLENGE, True)
+    assert lists.check_per_site("example.com", "1.1.1.1") == (None, False)
+    assert lists.check_per_site("other.com", "90.90.90.90") == (None, False)
+
+
+def test_sitewide_sha_inv():
+    lists = make_lists()
+    assert lists.check_sitewide_sha_inv("example.com") == (FailAction.BLOCK, True)
+    assert lists.check_sitewide_sha_inv("foobar.com") == (FailAction.NO_BLOCK, True)
+    fa, ok = lists.check_sitewide_sha_inv("nope.com")
+    assert not ok
+
+
+def test_check_is_allowed():
+    lists = make_lists()
+    # global exact allow
+    assert lists.check_is_allowed("anything.com", "20.20.20.20")
+    # global CIDR allow
+    assert lists.check_is_allowed("anything.com", "10.9.9.9")
+    # per-site exact allow
+    assert lists.check_is_allowed("example.com", "90.90.90.90")
+    # challenge is not allow
+    assert not lists.check_is_allowed("anything.com", "8.8.8.8")
+    assert not lists.check_is_allowed("example.com", "91.91.91.91")
+    assert not lists.check_is_allowed("anything.com", "4.4.4.4")
+
+
+def test_hot_reload_swaps_snapshot():
+    lists = make_lists()
+    assert lists.check_global("20.20.20.20") == (Decision.ALLOW, True)
+    new_cfg = config_from_yaml_text(
+        """
+global_decision_lists:
+  nginx_block:
+    - 20.20.20.20
+"""
+    )
+    lists.update_from_config(new_cfg)
+    assert lists.check_global("20.20.20.20") == (Decision.NGINX_BLOCK, True)
+    assert lists.check_global("30.40.50.60") == (None, False)
+
+
+def test_filter_order_allow_wins_over_block():
+    # an IP covered by both an allow CIDR and a block CIDR: the filter scan
+    # order Allow→Challenge→NginxBlock→IptablesBlock means allow wins
+    cfg = config_from_yaml_text(
+        """
+global_decision_lists:
+  iptables_block:
+    - 10.0.0.0/8
+  allow:
+    - 10.1.0.0/16
+"""
+    )
+    lists = StaticDecisionLists(cfg)
+    assert lists.check_global("10.1.2.3") == (Decision.ALLOW, True)
+    assert lists.check_global("10.2.2.3") == (Decision.IPTABLES_BLOCK, True)

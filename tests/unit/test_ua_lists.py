@@ -1,0 +1,141 @@
+"""UA decision lists (reference: internal/user_agent_decision_test.go)."""
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.decisions.ua_lists import UAPattern, check_ua_decision
+
+
+def test_match_user_agent_substring():
+    p = UAPattern("GPTBot")
+    assert p.compiled is None
+    assert p.matches("Mozilla/5.0 (compatible; GPTBot/1.0; +https://openai.com/gptbot)")
+    assert not p.matches("Mozilla/5.0 (compatible; Googlebot/2.1)")
+
+
+def test_match_user_agent_regex():
+    p = UAPattern(r"Macintosh.*Firefox/\d+")
+    assert p.compiled is not None
+    assert p.matches("Mozilla/5.0 (Macintosh; Intel Mac OS X 10.15; rv:149.0) Gecko/20100101 Firefox/149.0")
+    assert not p.matches("Mozilla/5.0 (Windows NT 10.0; Win64; x64; rv:149.0) Gecko/20100101 Firefox/149.0")
+
+
+def test_match_user_agent_regex_case_insensitive():
+    p = UAPattern("(?i)scrapy|mechanize")
+    assert p.compiled is not None
+    assert p.matches("Scrapy/2.11.2 (+https://scrapy.org)")
+    assert p.matches("Python-Mechanize/0.4.9")
+    assert not p.matches("Mozilla/5.0 (compatible; Googlebot/2.1)")
+
+
+def test_invalid_regex_raises():
+    with pytest.raises(ValueError):
+        UAPattern("(?invalid")
+
+
+def test_check_ua_decision_severity_order():
+    rules = {
+        Decision.ALLOW: [UAPattern("TestBot")],
+        Decision.NGINX_BLOCK: [UAPattern("TestBot")],
+    }
+    decision, ok = check_ua_decision(rules, "TestBot/1.0")
+    assert ok
+    assert decision is Decision.NGINX_BLOCK
+
+
+def test_check_ua_decision_no_match():
+    rules = {Decision.NGINX_BLOCK: [UAPattern("AhrefsBot")]}
+    _, ok = check_ua_decision(rules, "Mozilla/5.0 (compatible; Googlebot/2.1)")
+    assert not ok
+
+
+UA_LISTS_YAML = r"""
+global_user_agent_decision_lists:
+  nginx_block:
+    - "AhrefsBot"
+    - "SemrushBot"
+  challenge:
+    - "(?i)scrapy|mechanize"
+  allow:
+    - "Googlebot"
+per_site_user_agent_decision_lists:
+  "example.com":
+    allow:
+      - "GPTBot"
+    nginx_block:
+      - "AhrefsBot"
+  "other.com":
+    challenge:
+      - "Macintosh.*Firefox/\\d+"
+"""
+
+
+@pytest.fixture()
+def lists():
+    return StaticDecisionLists(config_from_yaml_text(UA_LISTS_YAML))
+
+
+def test_check_global_user_agent(lists):
+    decision, ok = lists.check_global_user_agent("Mozilla/5.0 (compatible; AhrefsBot/7.0)")
+    assert ok and decision is Decision.NGINX_BLOCK
+
+    decision, ok = lists.check_global_user_agent("Mozilla/5.0 (compatible; SemrushBot/7.0)")
+    assert ok and decision is Decision.NGINX_BLOCK
+
+    decision, ok = lists.check_global_user_agent("Scrapy/2.11.2 (+https://scrapy.org)")
+    assert ok and decision is Decision.CHALLENGE
+
+    decision, ok = lists.check_global_user_agent("Mozilla/5.0 (compatible; Googlebot/2.1)")
+    assert ok and decision is Decision.ALLOW
+
+    _, ok = lists.check_global_user_agent("Mozilla/5.0 (compatible; GPTBot/1.0)")
+    assert not ok
+
+
+def test_check_per_site_user_agent(lists):
+    decision, ok = lists.check_per_site_user_agent("example.com", "Mozilla/5.0 (compatible; GPTBot/1.0)")
+    assert ok and decision is Decision.ALLOW
+
+    decision, ok = lists.check_per_site_user_agent("example.com", "Mozilla/5.0 (compatible; AhrefsBot/7.0)")
+    assert ok and decision is Decision.NGINX_BLOCK
+
+    decision, ok = lists.check_per_site_user_agent(
+        "other.com",
+        "Mozilla/5.0 (Macintosh; Intel Mac OS X 10.15; rv:149.0) Gecko/20100101 Firefox/149.0",
+    )
+    assert ok and decision is Decision.CHALLENGE
+
+    _, ok = lists.check_per_site_user_agent(
+        "other.com",
+        "Mozilla/5.0 (Windows NT 10.0; Win64; x64; rv:149.0) Gecko/20100101 Firefox/149.0",
+    )
+    assert not ok
+
+    _, ok = lists.check_per_site_user_agent("unknown.com", "Mozilla/5.0 (compatible; AhrefsBot/7.0)")
+    assert not ok
+
+
+def test_invalid_ua_decision_in_config():
+    cfg = config_from_yaml_text(
+        """
+global_user_agent_decision_lists:
+  bad_decision:
+    - "SomeBot"
+"""
+    )
+    with pytest.raises(ValueError):
+        StaticDecisionLists(cfg)
+
+
+def test_invalid_ua_regex_in_config():
+    cfg = config_from_yaml_text(
+        """
+global_user_agent_decision_lists:
+  nginx_block:
+    - "(?invalid"
+"""
+    )
+    with pytest.raises(ValueError):
+        StaticDecisionLists(cfg)
