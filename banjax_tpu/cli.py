@@ -1,0 +1,269 @@
+"""Entry point / process supervisor.
+
+Reference behavior: /root/reference/banjax.go:66-275 — parse the three CLI
+flags, build all shared state, wire and launch the long-lived workers (HTTP
+server, log tailer, Kafka reader/writer, metrics reporter, Kafka status
+heartbeat), install the SIGHUP hot-reload handler, and wait for
+SIGINT/SIGTERM.
+
+The supervisor is an object (BanjaxApp) so integration tests can run the real
+process in-process, the way the reference's standalone-testing tests run the
+real main() in a goroutine (banjax_base_test.go:32-81).
+
+Run:  python -m banjax_tpu.cli -config-file <path> [-standalone-testing] [-debug]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+from banjax_tpu.config.holder import ConfigHolder
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+from banjax_tpu.decisions.rate_limit import (
+    FailedChallengeRateLimitStates,
+    RegexRateLimitStates,
+)
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.effectors.banner import Banner
+from banjax_tpu.effectors.ipset import init_ipset
+from banjax_tpu.httpapi.server import ServerDeps, run_http_server
+from banjax_tpu.ingest.kafka_io import KafkaReader, KafkaWriter
+from banjax_tpu.ingest.reports import report_status_message
+from banjax_tpu.ingest.tailer import LogTailer
+from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from banjax_tpu.obs.metrics import MetricsReporter
+
+log = logging.getLogger(__name__)
+
+KAFKA_STATUS_INTERVAL_SECONDS = 19  # banjax.go:204
+
+
+def build_matcher(config, banner, static_lists, regex_states):
+    """The Matcher seam flag (BASELINE.json): cpu (default) or tpu."""
+    if config.matcher == "tpu":
+        from banjax_tpu.matcher.runner import TpuMatcher
+
+        return TpuMatcher(config, banner, static_lists, regex_states)
+    return CpuMatcher(config, banner, static_lists, regex_states)
+
+
+class BanjaxApp:
+    """Builds all state and owns the worker lifecycle (banjax.go main)."""
+
+    def __init__(self, config_file: str, standalone_testing: bool = False,
+                 debug: bool = False):
+        log.info("INIT: config file: %s", config_file)
+        self.config_holder = ConfigHolder(config_file, standalone_testing, debug)
+        config = self.config_holder.get()
+
+        self.regex_states = RegexRateLimitStates()
+        self.failed_challenge_states = FailedChallengeRateLimitStates()
+        self.protected_paths = PasswordProtectedPaths(config)
+        self.static_lists = StaticDecisionLists(config)
+        self.dynamic_lists = DynamicDecisionLists()
+
+        # ban log files (banjax.go:124-138)
+        self._banning_log_file = open(config.banning_log_file, "a", encoding="utf-8")
+        temp_path = config.banning_log_file_temp or f"{config.banning_log_file}.tmp"
+        self._banning_log_file_temp = open(temp_path, "a", encoding="utf-8")
+
+        self.banner = Banner(
+            decision_lists=self.dynamic_lists,
+            ban_log_file=self._banning_log_file,
+            ban_log_file_temp=self._banning_log_file_temp,
+            ipset_instance=init_ipset(
+                config.iptables_ban_seconds, config.standalone_testing
+            ),
+        )
+
+        self._matcher = None
+        self._matcher_generation = -1
+        self.tailer = LogTailer(config.server_log_file, self._consume_line)
+
+        self.kafka_reader: Optional[KafkaReader] = None
+        self.kafka_writer: Optional[KafkaWriter] = None
+
+        metrics_path = (
+            "list-metrics.log" if config.standalone_testing else config.metrics_log_file
+        )
+        self.metrics = MetricsReporter(
+            metrics_path, self.dynamic_lists, self.regex_states,
+            self.failed_challenge_states,
+        )
+
+        gin_log_name = "gin.log" if config.standalone_testing else config.gin_log_file
+        self._gin_log_file = None
+        if gin_log_name and gin_log_name != "-":
+            self._gin_log_file = open(gin_log_name, "w", encoding="utf-8")
+
+        self._server_log_file = None
+        if config.standalone_testing:
+            self._server_log_file = open(config.server_log_file, "a", encoding="utf-8")
+
+        self._stop_event = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._async_stop: Optional[asyncio.Event] = None
+        self._server_thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # --- the SIGHUP body (banjax.go:101-117) ---
+    def reload(self) -> None:
+        log.info("HOT-RELOAD: reloading config")
+        try:
+            self.config_holder.reload()
+        except Exception as e:  # noqa: BLE001 — keep serving on a bad reload
+            log.error("failed to reload config: %s", e)
+            return
+        new_config = self.config_holder.get()
+        self.static_lists.update_from_config(new_config)
+        self.dynamic_lists.clear()
+        self.protected_paths.update_from_config(new_config)
+
+    def _consume_line(self, line_text: str) -> None:
+        # rebuilt on config change so rules hot-reload (regex_rate_limiter.go:59)
+        cfg = self.config_holder.get()
+        if self._matcher_generation != self.config_holder.generation:
+            if self._matcher is not None:
+                self._matcher.close()
+            self._matcher = build_matcher(
+                cfg, self.banner, self.static_lists, self.regex_states
+            )
+            self._matcher_generation = self.config_holder.generation
+        result = self._matcher.consume_line(line_text)
+        if cfg.debug:
+            log.debug("consumeLine: %s", result)
+
+    def start_workers(self) -> None:
+        """Launch tailer, Kafka, metrics, heartbeat (not the HTTP server)."""
+        config = self.config_holder.get()
+        self.tailer.start()
+
+        if config.disable_kafka:
+            log.info("INIT: not running Kafka reader/writer due to disable_kafka")
+        elif config.disable_kafka_writer:
+            log.info("INIT: starting Kafka reader only due to disable_kafka_writer")
+            self.kafka_reader = KafkaReader(self.config_holder, self.dynamic_lists)
+            self.kafka_reader.start()
+        else:
+            log.info("INIT: starting Kafka reader/writer")
+            self.kafka_reader = KafkaReader(self.config_holder, self.dynamic_lists)
+            self.kafka_reader.start()
+            self.kafka_writer = KafkaWriter(self.config_holder)
+            self.kafka_writer.start()
+
+        self.metrics.start()
+
+        if not config.disable_kafka:
+            def heartbeat():
+                while not self._stop_event.wait(KAFKA_STATUS_INTERVAL_SECONDS):
+                    cfg = self.config_holder.get()
+                    if not cfg.disable_kafka:
+                        report_status_message(cfg)
+
+            threading.Thread(target=heartbeat, name="kafka-status", daemon=True).start()
+
+    def server_deps(self) -> ServerDeps:
+        return ServerDeps(
+            config_holder=self.config_holder,
+            static_lists=self.static_lists,
+            dynamic_lists=self.dynamic_lists,
+            protected_paths=self.protected_paths,
+            regex_states=self.regex_states,
+            failed_challenge_states=self.failed_challenge_states,
+            banner=self.banner,
+            gin_log_file=self._gin_log_file,
+            server_log_file=self._server_log_file,
+        )
+
+    async def _serve(self, install_signal_handlers: bool) -> None:
+        runner = await run_http_server(self.server_deps())
+        self._async_stop = asyncio.Event()
+        if install_signal_handlers:
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, self._async_stop.set)
+        self._started.set()
+        await self._async_stop.wait()
+        await runner.cleanup()
+
+    def run_forever(self) -> None:
+        """Blocking run for the CLI (main thread; installs signal handlers)."""
+        signal.signal(signal.SIGHUP, lambda s, f: self.reload())
+        self.start_workers()
+        try:
+            asyncio.run(self._serve(install_signal_handlers=True))
+        finally:
+            self.shutdown()
+
+    def start_background(self, timeout: float = 10.0) -> None:
+        """Non-blocking run for tests; waits until the server is listening."""
+        self.start_workers()
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(self._serve(install_signal_handlers=False))
+
+        self._server_thread = threading.Thread(target=run, name="http-server", daemon=True)
+        self._server_thread.start()
+        if not self._started.wait(timeout):
+            raise RuntimeError("http server did not start in time")
+
+    def stop_background(self) -> None:
+        if self._loop is not None and self._async_stop is not None:
+            self._loop.call_soon_threadsafe(self._async_stop.set)
+        if self._server_thread is not None:
+            self._server_thread.join(timeout=5)
+        self.shutdown()
+
+    def shutdown(self) -> None:
+        self._stop_event.set()
+        self.tailer.stop()
+        self.metrics.stop()
+        if self.kafka_reader:
+            self.kafka_reader.stop()
+        if self.kafka_writer:
+            self.kafka_writer.stop()
+        if self._matcher is not None:
+            self._matcher.close()
+        self.dynamic_lists.close()
+        for f in (self._banning_log_file, self._banning_log_file_temp,
+                  self._gin_log_file, self._server_log_file):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+
+
+def main(argv: Optional[list] = None) -> int:
+    # Go-style single-dash long flags (banjax.go:67-69)
+    parser = argparse.ArgumentParser(prog="banjax-tpu", prefix_chars="-")
+    parser.add_argument("-standalone-testing", dest="standalone_testing",
+                        action="store_true", help="makes it easy to test standalone")
+    parser.add_argument("-config-file", dest="config_file",
+                        default="/etc/banjax/banjax-config.yaml", help="config file")
+    parser.add_argument("-debug", dest="debug", action="store_true",
+                        help="debug mode with verbose logging")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    app = BanjaxApp(args.config_file, args.standalone_testing, args.debug)
+    app.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,12 +20,9 @@ snapshot generation counter).
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from pathlib import Path
-from typing import Optional
-
 from banjax_tpu.config.schema import Config, config_from_yaml_text, default_hostname
 
 log = logging.getLogger(__name__)

@@ -22,7 +22,7 @@ import dataclasses
 import re
 import socket
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import yaml
 
@@ -145,24 +145,32 @@ class Config:
     matcher_max_line_len: int = 256
 
 
+# yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
+# value (e.g. a quoted "10" for an int field) fails the whole config load
+# rather than crashing later at request time
 _SCALAR_KEYS = {
-    # yaml key -> attribute (identical names here; kept explicit for clarity)
-    "server_log_file", "banning_log_file", "iptables_ban_seconds",
-    "iptables_unbanner_seconds", "kafka_security_protocol", "kafka_ssl_ca",
-    "kafka_ssl_cert", "kafka_ssl_key", "kafka_ssl_key_password",
-    "kafka_command_topic", "kafka_report_topic", "kafka_min_bytes",
-    "kafka_max_bytes", "kafka_max_wait_ms", "kafka_dialer_timeout_seconds",
-    "kafka_dialer_keep_alive_seconds", "config_version",
-    "expiring_decision_ttl_seconds", "block_ip_ttl_seconds",
-    "block_session_ttl_seconds", "too_many_failed_challenges_interval_seconds",
-    "too_many_failed_challenges_threshold", "password_cookie_ttl_seconds",
-    "sha_inv_cookie_ttl_seconds", "sha_inv_expected_zero_bits", "hmac_secret",
-    "gin_log_file", "metrics_log_file", "sha_inv_challenge_html",
-    "password_protected_path_html", "debug", "profile",
-    "banning_log_file_temp", "disable_kafka", "disable_kafka_writer",
-    "session_cookie_hmac_secret", "session_cookie_ttl_seconds",
-    "session_cookie_not_verify", "dnet", "standalone_testing",
-    "matcher", "matcher_batch_lines", "matcher_max_line_len",
+    "server_log_file": str, "banning_log_file": str,
+    "iptables_ban_seconds": int, "iptables_unbanner_seconds": int,
+    "kafka_security_protocol": str, "kafka_ssl_ca": str,
+    "kafka_ssl_cert": str, "kafka_ssl_key": str, "kafka_ssl_key_password": str,
+    "kafka_command_topic": str, "kafka_report_topic": str,
+    "kafka_min_bytes": int, "kafka_max_bytes": int, "kafka_max_wait_ms": int,
+    "kafka_dialer_timeout_seconds": int, "kafka_dialer_keep_alive_seconds": int,
+    "config_version": str,
+    "expiring_decision_ttl_seconds": int, "block_ip_ttl_seconds": int,
+    "block_session_ttl_seconds": int,
+    "too_many_failed_challenges_interval_seconds": int,
+    "too_many_failed_challenges_threshold": int,
+    "password_cookie_ttl_seconds": int, "sha_inv_cookie_ttl_seconds": int,
+    "sha_inv_expected_zero_bits": int, "hmac_secret": str,
+    "gin_log_file": str, "metrics_log_file": str,
+    "sha_inv_challenge_html": str, "password_protected_path_html": str,
+    "debug": bool, "profile": bool,
+    "banning_log_file_temp": str, "disable_kafka": bool,
+    "disable_kafka_writer": bool,
+    "session_cookie_hmac_secret": str, "session_cookie_ttl_seconds": int,
+    "session_cookie_not_verify": bool, "dnet": str, "standalone_testing": bool,
+    "matcher": str, "matcher_batch_lines": int, "matcher_max_line_len": int,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -196,12 +204,27 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
     cfg = Config()
     cfg.standalone_testing = standalone_testing_default
 
-    for key in _SCALAR_KEYS:
+    for key, typ in _SCALAR_KEYS.items():
         if key in raw and raw[key] is not None:
-            setattr(cfg, key, raw[key])
+            value = raw[key]
+            if typ is int:
+                if isinstance(value, bool) or not isinstance(value, int):
+                    raise ValueError(f"config key {key}: expected int, got {value!r}")
+            elif typ is bool:
+                if not isinstance(value, bool):
+                    raise ValueError(f"config key {key}: expected bool, got {value!r}")
+            elif not isinstance(value, typ):
+                raise ValueError(f"config key {key}: expected {typ.__name__}, got {value!r}")
+            setattr(cfg, key, value)
     for key in _DICT_OR_LIST_KEYS:
         if key in raw and raw[key] is not None:
-            setattr(cfg, key, raw[key])
+            value = raw[key]
+            expected = list if key == "kafka_brokers" else dict
+            if not isinstance(value, expected):
+                raise ValueError(
+                    f"config key {key}: expected {expected.__name__}, got {value!r}"
+                )
+            setattr(cfg, key, value)
 
     for entry in raw.get("regexes_with_rates") or []:
         cfg.regexes_with_rates.append(RegexWithRate.from_yaml_dict(entry))

@@ -11,7 +11,6 @@ The sha256 fingerprint hash is over a fixed '|'-joined field string.
 
 from __future__ import annotations
 
-import base64
 import dataclasses
 import hashlib
 import json

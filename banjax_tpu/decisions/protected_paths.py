@@ -11,7 +11,7 @@ beats a prefix-protected path.
 from __future__ import annotations
 
 import enum
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from banjax_tpu.config.schema import Config
 

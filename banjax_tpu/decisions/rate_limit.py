@@ -22,7 +22,7 @@ import dataclasses
 import enum
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from banjax_tpu.config.schema import Config, RegexWithRate
 

@@ -15,7 +15,6 @@ as a device-side mask over (ip, host) pairs before the window counters run.
 from __future__ import annotations
 
 import ipaddress
-import threading
 from typing import Dict, List, Optional, Tuple
 
 from banjax_tpu.config.schema import Config

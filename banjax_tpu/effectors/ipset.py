@@ -1,0 +1,104 @@
+"""Kernel-level blocking via ipset/iptables.
+
+Reference behavior: /root/reference/banjax.go:29-64 and internal/iptables.go:
+at startup create ipset `banjax_ipset` (hash:ip, default timeout
+iptables_ban_seconds) and insert an iptables INPUT rule
+`-m set --match-set banjax_ipset src -j DROP`; bans are `ipset add` entries
+with per-entry timeouts the kernel expires on its own; admin APIs
+test/list/del entries. Standalone-testing mode skips the kernel entirely.
+
+The reference links Go ipset/iptables libraries; here the same operations go
+through the `ipset`/`iptables` binaries via subprocess (the "native shim" —
+there is no stable Python netlink API in the stdlib, and these calls are rare:
+one per ban, not per request).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import subprocess
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+IPSET_NAME = "banjax_ipset"
+
+
+class IpsetError(RuntimeError):
+    pass
+
+
+def _run(args: List[str]) -> Tuple[int, str]:
+    try:
+        proc = subprocess.run(args, capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise IpsetError(f"{args[0]} invocation failed: {e}") from None
+    return proc.returncode, (proc.stdout or "") + (proc.stderr or "")
+
+
+class IpsetInstance:
+    """Operations on one named ipset. Mirrors the subset of gonetx/ipset the
+    reference uses (Add with Timeout, Test, List, Del)."""
+
+    def __init__(self, name: str = IPSET_NAME):
+        self.name = name
+
+    def add(self, ip: str, timeout_seconds: int) -> None:
+        code, out = _run(
+            ["ipset", "add", self.name, ip, "timeout", str(timeout_seconds), "-exist"]
+        )
+        if code != 0:
+            raise IpsetError(f"ipset add failed: {out.strip()}")
+
+    def test(self, ip: str) -> bool:
+        code, _ = _run(["ipset", "test", self.name, ip])
+        return code == 0
+
+    def list_entries(self) -> List[str]:
+        """Entries formatted like the reference's API output:
+        `1.2.3.4 timeout 298`."""
+        code, out = _run(["ipset", "list", self.name])
+        if code != 0:
+            raise IpsetError(f"ipset list failed: {out.strip()}")
+        entries = []
+        in_members = False
+        for line in out.splitlines():
+            if line.startswith("Members:"):
+                in_members = True
+                continue
+            if in_members and line.strip():
+                entries.append(line.strip())
+        return entries
+
+    def delete(self, ip: str) -> None:
+        code, out = _run(["ipset", "del", self.name, ip])
+        if code != 0:
+            raise IpsetError(f"ipset del failed: {out.strip()}")
+
+
+def init_ipset(iptables_ban_seconds: int, standalone_testing: bool) -> Optional[IpsetInstance]:
+    """Port of banjax.go init_ipset: create the set and the DROP rule.
+
+    Returns None in standalone testing (banjax.go:30-33). Raises on failure
+    otherwise (the reference panics)."""
+    if standalone_testing:
+        log.info("init_ipset: not initializing ipset in testing")
+        return None
+
+    code, out = _run(
+        ["ipset", "create", IPSET_NAME, "hash:ip",
+         "timeout", str(iptables_ban_seconds), "-exist"]
+    )
+    if code != 0:
+        raise IpsetError(f"ipset create failed: {out.strip()}")
+
+    # idempotent insert: only add the DROP rule if it isn't there already
+    rule = ["-m", "set", "--match-set", IPSET_NAME, "src", "-j", "DROP"]
+    code, _ = _run(["iptables", "-C", "INPUT"] + rule)
+    if code != 0:
+        code, out = _run(["iptables", "-I", "INPUT", "1"] + rule)
+        if code != 0:
+            raise IpsetError(f"iptables insert failed: {out.strip()}")
+
+    return IpsetInstance(IPSET_NAME)

@@ -1,0 +1,363 @@
+"""The HTTP request API.
+
+Reference behavior: /root/reference/internal/http_server.go:32-332 — a server
+on hard-coded 127.0.0.1:8081 with: a JSON access-log middleware, a recovery
+middleware that FAILS OPEN (X-Accel-Redirect: @fail_open + 500 with an
+X-Banjax-Error header) on any handler crash, a standalone-testing middleware
+that fakes the Nginx X-* headers and writes the Nginx-format access log line
+itself, and these routes:
+
+  ANY  /auth_request        — the decision chain
+  GET  /info                — config version
+  GET  /decision_lists      — formatted static+dynamic lists
+  GET  /rate_limit_states   — formatted rate-limit states
+  GET  /is_banned?ip=       — expiring-list + ipset lookup
+  GET  /ipset/list          — raw ipset entries
+  GET  /banned?domain=      — expiring entries for a domain
+  POST /unban               — remove an IP from expiring list + ipset
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+import traceback
+from typing import Optional, TextIO
+
+from aiohttp import web
+
+from banjax_tpu.config.holder import ConfigHolder
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.decisions.rate_limit import (
+    FailedChallengeRateLimitStates,
+    RegexRateLimitStates,
+)
+from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.effectors.banner import BannerInterface
+from banjax_tpu.httpapi.decision_chain import (
+    ChainState,
+    DecisionListResult,
+    RequestInfo,
+    Response,
+    decision_for_nginx,
+)
+from banjax_tpu.utils import go_query_escape, go_query_unescape
+
+log = logging.getLogger(__name__)
+
+LISTEN_HOST = "127.0.0.1"
+LISTEN_PORT = 8081  # http_server.go:42 (XXX config — kept identical)
+
+
+@dataclasses.dataclass
+class ServerDeps:
+    config_holder: ConfigHolder
+    static_lists: StaticDecisionLists
+    dynamic_lists: DynamicDecisionLists
+    protected_paths: PasswordProtectedPaths
+    regex_states: RegexRateLimitStates
+    failed_challenge_states: FailedChallengeRateLimitStates
+    banner: BannerInterface
+    gin_log_file: Optional[TextIO] = None  # the JSON access log
+    server_log_file: Optional[TextIO] = None  # standalone: fake nginx log
+
+
+def _request_info(request: web.Request) -> RequestInfo:
+    # gin reads cookies through url.QueryUnescape (c.Cookie); a value whose
+    # unescape fails is treated as an absent cookie
+    cookies = {}
+    for name, value in request.cookies.items():
+        try:
+            cookies[name] = go_query_unescape(value)
+        except ValueError:
+            continue
+    return RequestInfo(
+        client_ip=request.headers.get("X-Client-IP", ""),
+        requested_host=request.headers.get("X-Requested-Host", ""),
+        requested_path=request.headers.get("X-Requested-Path", ""),
+        client_user_agent=request.headers.get("X-Client-User-Agent", ""),
+        method=request.method,
+        cookies=cookies,
+    )
+
+
+def _to_web_response(resp: Response) -> web.Response:
+    out = web.Response(
+        status=resp.status, body=resp.body, content_type=resp.content_type
+    )
+    for k, v in resp.headers.items():
+        out.headers[k] = v
+    for c in resp.cookies:
+        # gin SetCookie url.QueryEscape's the value; the page JS
+        # decodeURIComponent's it back — keep the same wire encoding
+        out.set_cookie(
+            c.name, go_query_escape(c.value), max_age=c.max_age, path=c.path,
+            domain=c.domain or None, secure=c.secure, httponly=c.http_only,
+        )
+    return out
+
+
+def build_app(deps: ServerDeps) -> web.Application:
+    middlewares = []
+
+    config0 = deps.config_holder.get()
+
+    # --- access log middleware (http_server.go:65-95) ---
+    if deps.gin_log_file is not None:
+        @web.middleware
+        async def access_log_middleware(request: web.Request, handler):
+            start = time.monotonic()
+            response = await handler(request)
+            latency_us = int((time.monotonic() - start) * 1e6)
+            line = {
+                "Time": time.strftime("%a, %d %b %Y %H:%M:%S %Z"),
+                "ClientIp": request.headers.get("X-Client-IP", ""),
+                "ClientReqHost": request.headers.get("X-Requested-Host", ""),
+                "ClientReqPath": request.headers.get("X-Requested-Path", ""),
+                "Method": request.method,
+                "Path": request.path,
+                "Status": response.status,
+                "Latency": latency_us,
+            }
+            deps.gin_log_file.write(json.dumps(line) + "\n")
+            deps.gin_log_file.flush()
+            return response
+
+        middlewares.append(access_log_middleware)
+
+    # --- fail-open recovery middleware (http_server.go:110-135) ---
+    @web.middleware
+    async def recovery_middleware(request: web.Request, handler):
+        try:
+            return await handler(request)
+        except web.HTTPException:
+            raise  # normal HTTP responses (404 etc.), not crashes
+        except Exception as e:  # noqa: BLE001 — this IS the crash handler
+            tb = traceback.extract_tb(e.__traceback__)
+            location = f"{tb[-1].filename}:{tb[-1].lineno}" if tb else "unknown"
+            log.error("handler panic: %s (%s)", e, location)
+            headers = {
+                "X-Banjax-Error": f"{e} ({location})",
+                "X-Accel-Redirect": "@fail_open",
+            }
+            return web.Response(status=500, headers=headers)
+
+    middlewares.append(recovery_middleware)
+
+    # --- standalone-testing middleware (http_server.go:137-169) ---
+    if config0.standalone_testing:
+        log.info("!!! standalone-testing mode enabled. adding some X- headers here")
+
+        @web.middleware
+        async def standalone_middleware(request: web.Request, handler):
+            headers = request.headers.copy()
+            if not headers.get("X-Client-IP"):
+                peer = request.remote or "127.0.0.1"
+                headers["X-Client-IP"] = peer
+            headers["X-Requested-Host"] = request.host
+            headers["X-Requested-Path"] = request.query.get("path", "")
+            if not headers.get("X-Client-User-Agent"):
+                headers["X-Client-User-Agent"] = "mozilla"
+            request = request.clone(headers=headers)
+
+            # write the fake nginx banjax_format line so the log tailer has
+            # input: '$msec $remote_addr $request_method $host $request $ua'
+            if deps.server_log_file is not None:
+                deps.server_log_file.write(
+                    "%f %s %s %s %s %s HTTP/1.1 %s\n"
+                    % (
+                        float(int(time.time())),
+                        request.headers.get("X-Client-IP", ""),
+                        request.method,
+                        request.host,
+                        request.method,
+                        request.query.get("path", ""),
+                        request.headers.get("User-Agent", ""),
+                    )
+                )
+                deps.server_log_file.flush()
+            return await handler(request)
+
+        middlewares.append(standalone_middleware)
+
+    app = web.Application(middlewares=middlewares)
+
+    # ---------------- routes ----------------
+
+    async def auth_request(request: web.Request) -> web.Response:
+        config = deps.config_holder.get()
+        state = ChainState(
+            config=config,
+            static_lists=deps.static_lists,
+            dynamic_lists=deps.dynamic_lists,
+            protected_paths=deps.protected_paths,
+            failed_challenge_states=deps.failed_challenge_states,
+            banner=deps.banner,
+        )
+        resp, result = decision_for_nginx(state, _request_info(request))
+        if config.debug:
+            log.info("decisionForNginx: %s", result.to_json())
+        elif result.decision_list_result != DecisionListResult.NO_MENTION:
+            log.info("decisionForNginx: %s", result.to_json())
+        return _to_web_response(resp)
+
+    async def info(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"config_version": deps.config_holder.get().config_version}
+        )
+
+    async def decision_lists_route(request: web.Request) -> web.Response:
+        per_site, global_ = deps.static_lists.format_lists()
+        expiring = deps.dynamic_lists.format_ip_entries()
+
+        def fmt_ip_map(m):
+            return "".join(f"{ip}:\n\t{d}\n" for ip, d in m.items())
+
+        per_site_str = "".join(
+            f"{site}:\n" + "".join(f"\t{ip}:\n\t\t{d}\n" for ip, d in ips.items())
+            for site, ips in per_site.items()
+        )
+        expiring_str = "".join(
+            f"{ip}:\n\t{ed.domain} {ed.decision} until "
+            f"{time.strftime('%H:%M:%S', time.localtime(ed.expires))} "
+            f"(baskerville: {str(ed.from_baskerville).lower()})\n"
+            for ip, ed in expiring.items()
+        )
+        body = (
+            f"per_site:\n{per_site_str}\n\nglobal:\n{fmt_ip_map(global_)}\n\n"
+            f"expiring:\n{expiring_str}"
+        )
+        return web.Response(text=body)
+
+    async def rate_limit_states_route(request: web.Request) -> web.Response:
+        body = (
+            f"regexes:\n{deps.regex_states.format_states()}\n"
+            f"failed challenges:\n{deps.failed_challenge_states.format_states()}\n"
+        )
+        return web.Response(text=body)
+
+    async def is_banned(request: web.Request) -> web.Response:
+        ip = request.query.get("ip", "")
+        if not ip:
+            return web.json_response({"error": "ip query param is required"}, status=400)
+        banned = deps.banner.ipset_list()
+        expiring, ok = deps.dynamic_lists.check("", ip)
+        if not ok:
+            return web.json_response(
+                {"ip": ip, "banned": banned, "expiringDecision": None}
+            )
+        return web.json_response(
+            {
+                "ip": ip,
+                "banned": banned,
+                "expiringDecision": {
+                    "Decision": str(expiring.decision),
+                    "Expires": expiring.expires,
+                    "IpAddress": expiring.ip_address,
+                },
+            }
+        )
+
+    async def ipset_list_route(request: web.Request) -> web.Response:
+        try:
+            entries = deps.banner.ipset_list()
+        except Exception as e:  # noqa: BLE001 — surface as 500 JSON like the reference
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"entries": entries})
+
+    async def banned_route(request: web.Request) -> web.Response:
+        domain = request.query.get("domain", "")
+        if not domain:
+            return web.json_response({"error": "domain query param is required"}, status=400)
+        entries = deps.dynamic_lists.check_by_domain(domain)
+        return web.json_response(
+            {
+                "domain": domain,
+                "entries": [
+                    {
+                        "ip": e.ip_or_session_id,
+                        "decision": e.decision,
+                        "expires": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(e.expires)
+                        ),
+                        "from_baskerville": e.from_baskerville,
+                    }
+                    for e in entries
+                ],
+            }
+        )
+
+    async def unban(request: web.Request) -> web.Response:
+        config = deps.config_holder.get()
+        form = await request.post()
+        ip = str(form.get("ip", "")).strip()
+        if not ip:
+            return web.json_response({"error": "ip in post form is required"}, status=400)
+        expiring, ok = deps.dynamic_lists.check("", ip)
+        decision_str = str(expiring.decision) if ok and expiring else ""
+        if not ok or (expiring and expiring.decision == Decision.IPTABLES_BLOCK):
+            if not deps.banner.ipset_test(config, ip):
+                return web.json_response(
+                    {
+                        "ip": ip,
+                        "found_in_decision_list": ok,
+                        "decision": decision_str,
+                        "unban": False,
+                        "error": "ip is not banned",
+                    },
+                    status=400,
+                )
+            try:
+                deps.banner.ipset_del(ip)
+            except Exception as e:  # noqa: BLE001 — reference returns the error as 500 JSON
+                return web.json_response(
+                    {
+                        "ip": ip,
+                        "found_in_decision_list": ok,
+                        "decision": decision_str,
+                        "unban": False,
+                        "error": str(e),
+                    },
+                    status=500,
+                )
+        if ok:
+            deps.dynamic_lists.remove_by_ip(ip)
+        return web.json_response(
+            {
+                "ip": ip,
+                "found_in_decision_list": ok,
+                "decision": decision_str,
+                "unban": True,
+            }
+        )
+
+    app.router.add_route("*", "/auth_request", auth_request)
+    app.router.add_get("/info", info)
+    app.router.add_get("/decision_lists", decision_lists_route)
+    app.router.add_get("/rate_limit_states", rate_limit_states_route)
+    app.router.add_get("/is_banned", is_banned)
+    app.router.add_get("/ipset/list", ipset_list_route)
+    app.router.add_get("/banned", banned_route)
+    app.router.add_post("/unban", unban)
+
+    if config0.standalone_testing:
+        async def favicon(request: web.Request) -> web.Response:
+            return web.Response(text="")
+        app.router.add_get("/favicon.ico", favicon)
+
+    return app
+
+
+async def run_http_server(deps: ServerDeps) -> web.AppRunner:
+    """Start the server; returns the runner for clean shutdown."""
+    app = build_app(deps)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, LISTEN_HOST, LISTEN_PORT)
+    await site.start()
+    log.info("http server listening on %s:%s", LISTEN_HOST, LISTEN_PORT)
+    return runner

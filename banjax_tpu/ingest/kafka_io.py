@@ -1,0 +1,300 @@
+"""Kafka command reader and report writer.
+
+Reference behavior: /root/reference/internal/kafka.go —
+  * Reader: infinite reconnect loop (5 s backoff), pinned to partition
+    dnet_to_partition[dnet] (default 0) at the LAST offset, optional mTLS;
+    parses commandMessage{Name, Value, Host, SessionId, Source, PrintLog} and
+    dispatches challenge_ip / block_ip / challenge_session / block_session
+    into the dynamic decision lists with per-site TTL overrides — note the
+    reference's swapped-looking defaults: block_ip starts from
+    block_session_ttl_seconds and vice versa (kafka.go:176-192), preserved
+    here verbatim;
+  * Writer: drains the report queue (drop-don't-block producer side, see
+    banjax_tpu/ingest/reports.py) into the report topic, reconnecting with
+    5 s backoff on failure.
+
+Transport: this image has no Kafka client library, so the wire transport is
+pluggable. `KafkaTransport` is the interface; `NullTransport` (default when
+no client is importable) logs-and-drops like a disconnected broker, and tests
+inject `InMemoryTransport`. If `aiokafka` is available it is used
+automatically. All reference behaviors above live OUTSIDE the transport, so
+they are fully exercised in tests regardless of the wire client.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from typing import Iterator, List, Optional
+
+from banjax_tpu.utils import go_query_unescape
+
+from banjax_tpu.config.holder import ConfigHolder
+from banjax_tpu.config.schema import Config
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.ingest.reports import get_message_queue
+
+log = logging.getLogger(__name__)
+
+RECONNECT_SECONDS = 5  # kafka.go:169
+
+
+def get_dnet_partition(config: Config) -> int:
+    """kafka.go:47-55."""
+    partition = config.dnet_to_partition.get(config.dnet)
+    if partition is not None:
+        log.info("KAFKA: using dnet %s mapping to partition %d", config.dnet, partition)
+        return partition
+    log.info("KAFKA: dnet %s not found in dnet_to_partition mapping, using partition 0",
+             config.dnet)
+    return 0
+
+
+# ------------------------------------------------------------- transports
+
+
+class KafkaTransport:
+    """Minimal transport contract: blocking message iteration + send."""
+
+    def read_messages(self, config: Config, topic: str, partition: int) -> Iterator[bytes]:
+        raise NotImplementedError
+
+    def send(self, config: Config, topic: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class NullTransport(KafkaTransport):
+    """Behaves like a permanently-unreachable broker."""
+
+    def read_messages(self, config: Config, topic: str, partition: int) -> Iterator[bytes]:
+        raise ConnectionError("no kafka client available")
+
+    def send(self, config: Config, topic: str, value: bytes) -> None:
+        raise ConnectionError("no kafka client available")
+
+
+class InMemoryTransport(KafkaTransport):
+    """Test transport: push commands in, collect reports out."""
+
+    def __init__(self) -> None:
+        self.incoming: "queue.Queue[bytes]" = queue.Queue()
+        self.sent: List[bytes] = []
+        self._closed = threading.Event()
+
+    def push_command(self, obj: dict) -> None:
+        self.incoming.put(json.dumps(obj).encode())
+
+    def read_messages(self, config: Config, topic: str, partition: int) -> Iterator[bytes]:
+        while not self._closed.is_set():
+            try:
+                yield self.incoming.get(timeout=0.1)
+            except queue.Empty:
+                continue
+
+    def send(self, config: Config, topic: str, value: bytes) -> None:
+        self.sent.append(value)
+
+    def close(self) -> None:
+        self._closed.set()
+
+
+def default_transport() -> KafkaTransport:
+    try:
+        import aiokafka  # noqa: F401 — optional, absent in this image
+        from banjax_tpu.ingest.kafka_aiokafka import AiokafkaTransport  # type: ignore
+
+        return AiokafkaTransport()
+    except ImportError:
+        log.warning("KAFKA: no kafka client library available; using NullTransport "
+                    "(reader/writer will retry-and-drop)")
+        return NullTransport()
+
+
+# ----------------------------------------------------------- TTL selection
+
+
+def get_block_ip_ttl(config: Config, host: str) -> int:
+    """kafka.go:176-183 — note: default comes from block_session_ttl_seconds
+    (reference quirk, preserved)."""
+    ttl = config.sites_to_block_ip_ttl_seconds.get(host)
+    if ttl is not None:
+        log.info("KAFKA: found site-specific block_ip ttl %s %d", host, ttl)
+        return ttl
+    return config.block_session_ttl_seconds
+
+
+def get_block_session_ttl(config: Config, host: str) -> int:
+    """kafka.go:185-192 — default from block_ip_ttl_seconds (same quirk)."""
+    ttl = config.sites_to_block_session_ttl_seconds.get(host)
+    if ttl is not None:
+        log.info("KAFKA: found site-specific block_session ttl %s %d", host, ttl)
+        return ttl
+    return config.block_ip_ttl_seconds
+
+
+# ------------------------------------------------------------- dispatching
+
+
+def handle_command(config: Config, command: dict, decision_lists: DynamicDecisionLists) -> None:
+    """kafka.go:194-226."""
+    host = command.get("host", "")
+    name = command.get("Name", "")
+
+    if host in config.sites_to_disable_baskerville:
+        if config.debug:
+            log.info("KAFKA: %s disabled baskerville, skipping %s", host, name)
+        return
+
+    if name == "challenge_ip":
+        _handle_ip_command(config, command, decision_lists, Decision.CHALLENGE,
+                           config.expiring_decision_ttl_seconds)
+    elif name == "block_ip":
+        _handle_ip_command(config, command, decision_lists, Decision.NGINX_BLOCK,
+                           get_block_ip_ttl(config, host))
+    elif name == "challenge_session":
+        _handle_session_command(config, command, decision_lists, Decision.CHALLENGE,
+                                config.expiring_decision_ttl_seconds)
+    elif name == "block_session":
+        _handle_session_command(config, command, decision_lists, Decision.NGINX_BLOCK,
+                                get_block_session_ttl(config, host))
+    elif config.debug:
+        log.info("KAFKA: unrecognized command name: %s", name)
+
+
+def _handle_ip_command(
+    config: Config, command: dict, decision_lists: DynamicDecisionLists,
+    decision: Decision, expire_duration: int,
+) -> None:
+    """kafka.go:228-253."""
+    value = command.get("Value", "")
+    if len(value) <= 4:
+        log.warning("KAFKA: command value looks malformed: %s", value)
+        return
+    decision_lists.update(
+        value,
+        time.time() + expire_duration,
+        decision,
+        True,  # from baskerville
+        command.get("host", ""),
+    )
+
+
+def _handle_session_command(
+    config: Config, command: dict, decision_lists: DynamicDecisionLists,
+    decision: Decision, expire_duration: int,
+) -> None:
+    """kafka.go:255-283 — session ids are url-decoded (gin cookie parity)."""
+    session_id_raw = command.get("session_id", "")
+    try:
+        session_id = go_query_unescape(session_id_raw)
+    except ValueError:
+        log.warning("KAFKA: fail to urldecode session_id %s, skip command", session_id_raw)
+        return
+    decision_lists.update_by_session_id(
+        command.get("Value", ""),
+        session_id,
+        time.time() + expire_duration,
+        decision,
+        True,
+        command.get("host", ""),
+    )
+
+
+# -------------------------------------------------------------- the loops
+
+
+class KafkaReader:
+    """kafka.go:93-174 — reconnect loop around the transport."""
+
+    def __init__(
+        self,
+        config_holder: ConfigHolder,
+        decision_lists: DynamicDecisionLists,
+        transport: Optional[KafkaTransport] = None,
+    ):
+        self.config_holder = config_holder
+        self.decision_lists = decision_lists
+        self.transport = transport or default_transport()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="kafka-reader", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.transport.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            config = self.config_holder.get()
+            partition = get_dnet_partition(config)
+            try:
+                for raw in self.transport.read_messages(
+                    config, config.kafka_command_topic, partition
+                ):
+                    if self._stop.is_set():
+                        return
+                    try:
+                        command = json.loads(raw)
+                    except json.JSONDecodeError:
+                        log.warning("KAFKA: unmarshal failed: %r", raw[:200])
+                        continue
+                    if not isinstance(command, dict):
+                        continue
+                    if config.debug or command.get("print_log"):
+                        log.info("KAFKA: message N: %s, V: %s, S: %s, Src: %s",
+                                 command.get("Name"), command.get("Value"),
+                                 command.get("session_id"), command.get("source"))
+                    handle_command(self.config_holder.get(), command, self.decision_lists)
+            except Exception as e:  # noqa: BLE001 — any transport failure → reconnect
+                log.warning("KAFKA: reader failed: %s", e)
+            if self._stop.wait(RECONNECT_SECONDS):
+                return
+            log.info("KAFKA: reconnecting kafka reader")
+
+
+class KafkaWriter:
+    """kafka.go:353-406 — drain the report queue into the report topic."""
+
+    def __init__(self, config_holder: ConfigHolder, transport: Optional[KafkaTransport] = None):
+        self.config_holder = config_holder
+        self.transport = transport or default_transport()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="kafka-writer", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.transport.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        message_queue = get_message_queue()
+        while not self._stop.is_set():
+            config = self.config_holder.get()
+            try:
+                while not self._stop.is_set():
+                    try:
+                        msg = message_queue.get(timeout=0.2)
+                    except queue.Empty:
+                        continue
+                    self.transport.send(config, config.kafka_report_topic, msg)
+            except Exception as e:  # noqa: BLE001 — any transport failure → reconnect
+                log.warning("KAFKA: writer failed: %s", e)
+            if self._stop.wait(RECONNECT_SECONDS):
+                return

@@ -1,0 +1,96 @@
+"""Log tailer: follow the Nginx access log from EOF and feed the matcher.
+
+Reference behavior: /root/reference/internal/regex_rate_limiter.go:21-78 —
+tail the server_log_file with Follow + SeekEnd (retrying every 5 s until the
+file exists), then hand each line to consumeLine with the *latest* config
+snapshot (so rate-limit rules hot-reload without restarting the tailer).
+
+The reference uses inotify via hpcloud/tail; here a poll-based follower
+(50 ms idle sleep) keeps the dependency surface zero and handles truncation
+and rotation (size shrink or inode change → reopen from start).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+RETRY_SECONDS = 5  # regex_rate_limiter.go:47
+POLL_SECONDS = 0.05
+
+
+class LogTailer:
+    """Calls `on_line(text)` for every new line appended to `path`."""
+
+    def __init__(self, path: str, on_line: Callable[[str], None]):
+        self.path = path
+        self.on_line = on_line
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="log-tailer", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _open_at_end(self):
+        f = open(self.path, "r", encoding="utf-8", errors="replace")
+        f.seek(0, os.SEEK_END)
+        return f
+
+    def _run(self) -> None:
+        f = None
+        # retry-until-exists loop (regex_rate_limiter.go:30-51)
+        while not self._stop.is_set():
+            try:
+                f = self._open_at_end()
+                break
+            except OSError:
+                log.info("log tailer failed to start. waiting a bit and trying again.")
+                if self._stop.wait(RETRY_SECONDS):
+                    return
+
+        if f is None:
+            return
+        log.info("log tailer started on %s", self.path)
+
+        inode = os.fstat(f.fileno()).st_ino
+        buffer = ""
+        while not self._stop.is_set():
+            chunk = f.read()
+            if chunk:
+                buffer += chunk
+                while "\n" in buffer:
+                    line, buffer = buffer.split("\n", 1)
+                    if line:
+                        try:
+                            self.on_line(line)
+                        except Exception:  # noqa: BLE001 — one bad line must not kill the tailer
+                            log.exception("error consuming log line")
+                continue
+
+            # idle: check rotation/truncation
+            try:
+                st = os.stat(self.path)
+                pos = f.tell()
+                if st.st_ino != inode or st.st_size < pos:
+                    log.info("log file rotated/truncated; reopening")
+                    f.close()
+                    f = open(self.path, "r", encoding="utf-8", errors="replace")
+                    inode = os.fstat(f.fileno()).st_ino
+                    buffer = ""
+                    continue
+            except OSError:
+                pass
+            self._stop.wait(POLL_SECONDS)
+
+        f.close()

@@ -1,0 +1,53 @@
+"""The Matcher seam: the contract of consumeLine, not its code.
+
+Reference behavior: /root/reference/internal/regex_rate_limiter.go:80-269.
+A Matcher consumes parsed log lines and produces per-line ConsumeLineResult
+records plus the side effects BanOrChallengeIp + LogRegexBan through the
+Banner boundary. Two implementations exist:
+
+  * CpuMatcher (cpu_ref.py) — line-at-a-time, semantics-identical to the Go
+    loop; the default and the correctness oracle.
+  * TpuMatcher (runner.py)  — batches lines into device tensors, matches all
+    rules at once with the Pallas NFA kernel, and runs the fixed-window
+    counters on device; selected with `matcher: tpu` in banjax-config.yaml.
+
+Both must produce byte-identical Decision streams for the same input.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from banjax_tpu.decisions.rate_limit import RateLimitResult
+
+
+@dataclasses.dataclass
+class RuleResult:
+    """regex_rate_limiter.go:87-93."""
+
+    rule_name: str = ""
+    regex_match: bool = False
+    skip_host: bool = False
+    seen_ip: bool = False
+    rate_limit_result: Optional[RateLimitResult] = None
+
+
+@dataclasses.dataclass
+class ConsumeLineResult:
+    """regex_rate_limiter.go:80-85."""
+
+    error: bool = False
+    old_line: bool = False
+    exempted: bool = False
+    rule_results: List[RuleResult] = dataclasses.field(default_factory=list)
+
+
+class Matcher:
+    """One log line in, one ConsumeLineResult out (plus Banner side effects)."""
+
+    def consume_line(self, line_text: str, now_unix: Optional[float] = None) -> ConsumeLineResult:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Flush any buffered device batches (no-op for the CPU matcher)."""

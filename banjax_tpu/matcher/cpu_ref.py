@@ -1,0 +1,143 @@
+"""The Go-semantics CPU reference matcher.
+
+Reference behavior: /root/reference/internal/regex_rate_limiter.go:113-269 —
+the exact consumeLine/applyRegexToLog pipeline:
+
+  * split "<epoch.frac> <ip> <rest>" (3 words minimum, else error);
+  * parse the float timestamp (nanosecond precision via float64 multiply);
+  * split rest into "<method> <host> <rest2>" (3 words minimum, else error);
+  * drop lines older than 10 s against the wall clock (fail-safe);
+  * skip IPs allowlisted for that host;
+  * apply per-site rules for the host FIRST, then global rules;
+  * per rule: regex over `rest` (unanchored search), hosts_to_skip check,
+    fixed-window rate limit, and on exceed → BanOrChallengeIp + LogRegexBan.
+
+This is the default matcher and the correctness oracle the TPU matcher is
+differential-tested against.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from banjax_tpu.config.schema import Config, RegexWithRate
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.effectors.banner import BannerInterface
+from banjax_tpu.matcher.api import ConsumeLineResult, Matcher, RuleResult
+
+log = logging.getLogger(__name__)
+
+OLD_LINE_CUTOFF_SECONDS = 10  # regex_rate_limiter.go:164
+
+
+def parse_timestamp_ns(timestamp_str: str) -> int:
+    """parseTimestamp (regex_rate_limiter.go:95-103): float seconds → int ns
+    via the same float64 multiply-then-truncate Go performs."""
+    seconds = float(timestamp_str)  # raises ValueError like Go's ParseFloat errors
+    return int(seconds * 1e9)
+
+
+class CpuMatcher(Matcher):
+    def __init__(
+        self,
+        config: Config,
+        banner: BannerInterface,
+        decision_lists: StaticDecisionLists,
+        rate_limit_states: RegexRateLimitStates,
+    ):
+        self.config = config
+        self.banner = banner
+        self.decision_lists = decision_lists
+        self.rate_limit_states = rate_limit_states
+
+    def consume_line(self, line_text: str, now_unix: Optional[float] = None) -> ConsumeLineResult:
+        result = ConsumeLineResult()
+        config = self.config
+
+        time_ip_rest = line_text.split(" ", 2)
+        if len(time_ip_rest) < 3:
+            log.warning("expected at least 3 words in log line: %s", time_ip_rest)
+            result.error = True
+            return result
+
+        ip_string = time_ip_rest[1]
+        try:
+            timestamp_ns = parse_timestamp_ns(time_ip_rest[0])
+        except ValueError:
+            log.warning("could not parse a timestamp float")
+            result.error = True
+            return result
+
+        method_url_rest = time_ip_rest[2].split(" ", 2)
+        if len(method_url_rest) < 3:
+            log.warning("expected at least method, url, rest")
+            result.error = True
+            return result
+        url_string = method_url_rest[1]
+
+        now = time.time() if now_unix is None else now_unix
+        if now - timestamp_ns / 1e9 > OLD_LINE_CUTOFF_SECONDS:
+            result.old_line = True
+            return result
+
+        if self.decision_lists.check_is_allowed(url_string, ip_string):
+            result.exempted = True
+            return result
+
+        # per-site rules for the host first (regex_rate_limiter.go:175-193)
+        for rule in config.per_site_regexes_with_rates.get(url_string, []):
+            rule_result = self._apply_regex_to_log(
+                rule, time_ip_rest[2], timestamp_ns, ip_string, url_string
+            )
+            if rule_result.regex_match:
+                result.rule_results.append(rule_result)
+
+        # then global rules (regex_rate_limiter.go:195-211)
+        for rule in config.regexes_with_rates:
+            rule_result = self._apply_regex_to_log(
+                rule, time_ip_rest[2], timestamp_ns, ip_string, url_string
+            )
+            if rule_result.regex_match:
+                result.rule_results.append(rule_result)
+
+        return result
+
+    def _apply_regex_to_log(
+        self,
+        rule: RegexWithRate,
+        rest: str,
+        timestamp_ns: int,
+        ip_string: str,
+        url_string: str,
+    ) -> RuleResult:
+        """applyRegexToLog (regex_rate_limiter.go:216-269)."""
+        result = RuleResult(rule_name=rule.rule)
+
+        if rule.regex.search(rest) is None:  # Go Regexp.Match = unanchored
+            result.regex_match = False
+            return result
+        result.regex_match = True
+
+        if rule.hosts_to_skip.get(url_string):
+            result.skip_host = True
+            return result
+        result.skip_host = False
+
+        seen_ip, rate_limit_result = self.rate_limit_states.apply(
+            ip_string, rule, timestamp_ns
+        )
+        result.seen_ip = seen_ip
+        result.rate_limit_result = rate_limit_result
+
+        if rate_limit_result.exceeded:
+            self.banner.ban_or_challenge_ip(
+                self.config, ip_string, rule.decision, url_string
+            )
+            self.banner.log_regex_ban(
+                self.config, timestamp_ns / 1e9, ip_string, rule.rule, rest, rule.decision
+            )
+
+        return result

@@ -26,6 +26,9 @@ _RE2_INCOMPATIBLE = re.compile(
   | \(\?\#        # comment group
   | \(\?P=        # named backreference
   | \(\?\(        # conditional group
+  | \(\?>         # atomic group (Python >= 3.11)
+  | [*+?]\+       # possessive quantifier *+ ++ ?+ (Python >= 3.11)
+  | \{\d+(,\d*)?\}\+   # possessive {m,n}+ (a literal '}' before '+' is fine)
     """,
     re.VERBOSE,
 )

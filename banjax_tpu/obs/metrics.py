@@ -1,0 +1,78 @@
+"""Metrics reporter: the 29-second JSON metrics line.
+
+Reference behavior: /root/reference/banjax.go:231-275 + config.go:150-181 —
+every 29 s write one JSON object {Time, LenExpiringChallenges,
+LenExpiringBlocks, LenIpToRegexStates, LenFailedChallengeStates} to
+metrics_log_file (or `list-metrics.log` in standalone testing).
+
+The TPU matcher additionally exposes counters (lines/sec, batch latency)
+through its own stats hook; those are reported by bench.py rather than here
+to keep this line's schema identical to the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional, TextIO
+
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.rate_limit import (
+    FailedChallengeRateLimitStates,
+    RegexRateLimitStates,
+)
+
+REPORT_INTERVAL_SECONDS = 29  # banjax.go:196
+
+
+def write_metrics_line(
+    out: TextIO,
+    dynamic_lists: DynamicDecisionLists,
+    regex_states: RegexRateLimitStates,
+    failed_challenge_states: FailedChallengeRateLimitStates,
+) -> None:
+    challenges, blocks = dynamic_lists.metrics()
+    line = {
+        "Time": time.strftime("%a, %d %b %Y %H:%M:%S %Z"),
+        "LenExpiringChallenges": challenges,
+        "LenExpiringBlocks": blocks,
+        "LenIpToRegexStates": len(regex_states),
+        "LenFailedChallengeStates": len(failed_challenge_states),
+    }
+    out.write(json.dumps(line) + "\n")
+    out.flush()
+
+
+class MetricsReporter:
+    def __init__(
+        self,
+        log_path: str,
+        dynamic_lists: DynamicDecisionLists,
+        regex_states: RegexRateLimitStates,
+        failed_challenge_states: FailedChallengeRateLimitStates,
+        interval_seconds: float = REPORT_INTERVAL_SECONDS,
+    ):
+        self.log_path = log_path
+        self.dynamic_lists = dynamic_lists
+        self.regex_states = regex_states
+        self.failed_challenge_states = failed_challenge_states
+        self.interval_seconds = interval_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if not self.log_path:
+            return
+        self._thread = threading.Thread(target=self._run, name="metrics-reporter", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        with open(self.log_path, "w", encoding="utf-8") as out:
+            while not self._stop.wait(self.interval_seconds):
+                write_metrics_line(
+                    out, self.dynamic_lists, self.regex_states, self.failed_challenge_states
+                )

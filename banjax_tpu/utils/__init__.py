@@ -1,0 +1,5 @@
+"""Small Go-stdlib-compatible helpers shared across modules."""
+
+from banjax_tpu.utils.goquery import go_query_escape, go_query_unescape
+
+__all__ = ["go_query_escape", "go_query_unescape"]

@@ -1,0 +1,267 @@
+"""In-process end-to-end tests against the real server in standalone mode.
+
+Model: the reference's integration tier (banjax_integration_test.go +
+banjax_base_test.go) — run the real supervisor with -standalone-testing in a
+temp dir, drive real HTTP against 127.0.0.1:8081, including the async
+regex-banner path (requests write the fake nginx log, the tailer picks lines
+up, the matcher bans) and SIGHUP-equivalent hot reload under load.
+"""
+
+import base64
+import hashlib
+import os
+import shutil
+import time
+from pathlib import Path
+
+import pytest
+import requests
+
+from banjax_tpu.cli import BanjaxApp
+from banjax_tpu.utils import go_query_unescape
+from banjax_tpu.crypto.challenge import parse_cookie, solve_challenge_for_testing
+
+FIXTURES = Path(__file__).resolve().parent.parent / "fixtures"
+BASE = "http://localhost:8081"
+
+
+@pytest.fixture()
+def app_factory(tmp_path, monkeypatch):
+    """Copies a fixture config into a temp cwd and runs the real app there
+    (banjax_base_test.go:32-81 setUp)."""
+    apps = []
+    monkeypatch.chdir(tmp_path)
+
+    def start(fixture_name: str) -> BanjaxApp:
+        config_path = tmp_path / "banjax-config.yaml"
+        shutil.copy(FIXTURES / fixture_name, config_path)
+        app = BanjaxApp(str(config_path), standalone_testing=True, debug=False)
+        app.start_background()
+        apps.append(app)
+        return app
+
+    yield start
+    for app in apps:
+        app.stop_background()
+
+
+def auth(path="/", ip=None, cookies=None, host=None, method="GET", ua=None):
+    headers = {}
+    if ip:
+        headers["X-Client-IP"] = ip
+    if ua:
+        headers["X-Client-User-Agent"] = ua
+    return requests.request(
+        method, f"{BASE}/auth_request", params={"path": path},
+        headers=headers, cookies=cookies or {}, timeout=5,
+    )
+
+
+def test_basic_routes_and_decisions(app_factory):
+    app = app_factory("banjax-config-test.yaml")
+
+    # /info
+    r = requests.get(f"{BASE}/info", timeout=5)
+    assert r.status_code == 200
+    assert r.json()["config_version"] == "2022-01-02_00:00:00"
+
+    # default allow
+    r = auth("/")
+    assert r.status_code == 200
+    assert r.headers["X-Accel-Redirect"] == "@access_granted"
+    assert "deflect_session" in r.cookies
+
+    # global challenge IP gets the PoW page
+    r = auth("/", ip="8.8.8.8")
+    assert r.status_code == 429
+    assert "deflect_challenge3" in r.cookies
+    assert b"new_solver(10)" in r.content
+
+    # CIDR challenge
+    r = auth("/", ip="192.168.1.77")
+    assert r.status_code == 429
+
+    # global block
+    r = auth("/", ip="70.80.90.100")
+    assert r.status_code == 403
+    assert r.headers["X-Accel-Redirect"] == "@access_denied"
+
+    # solve the challenge like the page JS would and get through
+    r = auth("/", ip="8.8.8.8")
+    unsolved = go_query_unescape(r.cookies["deflect_challenge3"])
+    solved = solve_challenge_for_testing(unsolved, 10)
+    r = auth("/", ip="8.8.8.8", cookies={"deflect_challenge3": solved})
+    assert r.status_code == 200
+    assert r.headers["X-Banjax-Decision"] == "ShaChallengePassed"
+
+    # introspection endpoints
+    r = requests.get(f"{BASE}/decision_lists", timeout=5)
+    assert r.status_code == 200 and "per_site" in r.text
+    r = requests.get(f"{BASE}/rate_limit_states", timeout=5)
+    assert r.status_code == 200
+    r = requests.get(f"{BASE}/is_banned", params={"ip": "1.2.3.4"}, timeout=5)
+    assert r.status_code == 200 and r.json()["expiringDecision"] is None
+    r = requests.get(f"{BASE}/is_banned", timeout=5)
+    assert r.status_code == 400
+    r = requests.get(f"{BASE}/banned", params={"domain": "x.com"}, timeout=5)
+    assert r.status_code == 200 and r.json()["entries"] == []
+    r = requests.get(f"{BASE}/ipset/list", timeout=5)
+    assert r.status_code == 200
+    r = requests.post(f"{BASE}/unban", data={"ip": "9.9.9.9"}, timeout=5)
+    assert r.status_code == 400  # not banned
+
+
+def test_password_protected_path_flow(app_factory):
+    app = app_factory("banjax-config-test.yaml")
+
+    # protected path serves the password page with per-site ttl applied
+    r = auth("wp-admin/x")
+    assert r.status_code == 401
+    assert "deflect_password3" in r.cookies
+    assert b"max-age=3600" in r.content  # per-site ttl for localhost:8081
+
+    # exception path passes
+    r = auth("wp-admin/admin-ajax.php")
+    assert r.status_code == 200
+
+    # build the solved password cookie like the page JS would
+    unsolved = go_query_unescape(auth("wp-admin/x").cookies["deflect_password3"])
+    hmac_b, _, expiry = parse_cookie(unsolved)
+    solution = hashlib.sha256(hmac_b + hashlib.sha256(b"password").digest()).digest()
+    solved = base64.standard_b64encode(hmac_b + solution + expiry).decode()
+    r = auth("wp-admin/x", cookies={"deflect_password3": solved})
+    assert r.status_code == 200
+
+    # wrong password still 401
+    bad_solution = hashlib.sha256(hmac_b + hashlib.sha256(b"wrong").digest()).digest()
+    bad = base64.standard_b64encode(hmac_b + bad_solution + expiry).decode()
+    r = auth("wp-admin/x", cookies={"deflect_password3": bad})
+    assert r.status_code == 401
+
+
+def test_failed_challenge_lockout(app_factory):
+    """401 x threshold, then 403 (banjax_integration_test.go:232-250)."""
+    app = app_factory("banjax-config-test.yaml")
+    ip = "13.13.13.13"
+    statuses = []
+    for _ in range(7):
+        r = auth("wp-admin/x", ip=ip, cookies={"deflect_password3": "garbage"})
+        statuses.append(r.status_code)
+    assert statuses == [401] * 6 + [403]
+    # the ban landed in the expiring list
+    r = requests.get(f"{BASE}/is_banned", params={"ip": ip}, timeout=5)
+    body = r.json()
+    assert body["expiringDecision"] is not None
+    # standalone testing: no real ipset call, but the decision is IptablesBlock
+    assert body["expiringDecision"]["Decision"] == "IptablesBlock"
+
+    # unban of an IptablesBlock checks the ipset; standalone has no ipset
+    # entry, so the reference's "ip is not banned" arm fires (400)
+    r = requests.post(f"{BASE}/unban", data={"ip": ip}, timeout=5)
+    assert r.status_code == 400 and r.json()["unban"] is False
+
+    # a NginxBlock entry unbans straight from the expiring list
+    import time as _time
+    from banjax_tpu.decisions.model import Decision
+    app.dynamic_lists.update("14.14.14.14", _time.time() + 60,
+                             Decision.NGINX_BLOCK, False, "localhost:8081")
+    r = requests.post(f"{BASE}/unban", data={"ip": "14.14.14.14"}, timeout=5)
+    assert r.status_code == 200 and r.json()["unban"] is True
+    r = requests.get(f"{BASE}/is_banned", params={"ip": "14.14.14.14"}, timeout=5)
+    assert r.json()["expiringDecision"] is None
+
+
+def test_regex_banner_bans_after_delay(app_factory):
+    """The async tailer path (banjax_integration_test.go:293-385): a request
+    whose path matches an instant-ban rule gets banned for the NEXT request."""
+    app = app_factory("banjax-config-test-regex-banner.yaml")
+
+    ip = "44.44.44.44"
+    r = auth("/challengeme", ip=ip)
+    assert r.status_code == 200  # first request passes; the log line is async
+
+    deadline = time.time() + 5
+    challenged = False
+    while time.time() < deadline:
+        r = auth("/", ip=ip)
+        if r.status_code == 429:
+            challenged = True
+            break
+        time.sleep(0.1)
+    assert challenged, "tailer should have inserted the challenge decision"
+
+    # allowlisted IP is exempt from regex rules
+    r = auth("/challengeme", ip="12.12.12.12")
+    assert r.status_code == 200
+    time.sleep(1.0)
+    r = auth("/", ip="12.12.12.12")
+    assert r.status_code == 200
+
+    # hosts_to_skip: the challenge-all rule skips localhost:8081, so a
+    # plain / request does not get challenged
+    r = auth("/", ip="55.55.55.55")
+    time.sleep(1.0)
+    r = auth("/", ip="55.55.55.55")
+    assert r.status_code == 200
+
+    # ban log line was written
+    ban_log = Path("banning-log-file.txt").read_text()
+    assert '"trigger":"instant challenge"' in ban_log
+    assert f'"client_ip":"{ip}"' in ban_log
+
+
+def test_hot_reload_under_load(app_factory, tmp_path):
+    """SIGHUP semantics (banjax_base_test.go:218-242): swap the config file,
+    reload, and verify behavior + /info version change with requests in flight."""
+    import threading
+
+    app = app_factory("banjax-config-test.yaml")
+    assert auth("wp-admin/x").status_code == 401  # wp-admin protected
+
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                auth("/", ip="8.8.8.8")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        shutil.copy(FIXTURES / "banjax-config-test-reload.yaml",
+                    tmp_path / "banjax-config.yaml")
+        app.reload()  # the SIGHUP handler body
+    finally:
+        stop.set()
+        t.join()
+    assert not errors
+
+    r = requests.get(f"{BASE}/info", timeout=5)
+    assert r.json()["config_version"] == "2022-01-02_00:00:01"
+    # wp-admin no longer protected; wp-admin2 now is
+    assert auth("wp-admin/x").status_code == 200
+    assert auth("wp-admin2/x").status_code == 401
+    # new per-site block entry applies
+    assert auth("/", ip="50.50.50.50").status_code == 403
+
+
+def test_fail_open_on_handler_crash(app_factory):
+    """The CustomRecovery fail-open contract (http_server.go:110-135)."""
+    app = app_factory("banjax-config-test.yaml")
+
+    # sabotage a component the handler touches to force an exception
+    app.server_deps()  # sanity
+    original = app.static_lists.check_per_site
+    app.static_lists.check_per_site = None  # type: ignore # next call raises TypeError
+    try:
+        r = auth("/", ip="1.2.3.4")
+        assert r.status_code == 500
+        assert r.headers["X-Accel-Redirect"] == "@fail_open"
+        assert "X-Banjax-Error" in r.headers
+    finally:
+        app.static_lists.check_per_site = original
+    # and the server still serves
+    assert auth("/").status_code == 200

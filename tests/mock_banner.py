@@ -1,0 +1,60 @@
+"""MockBanner: records effects instead of touching dynamic lists or ipset.
+
+Port of the reference's test mock (regex_rate_limiter_test.go:27-75); the
+BannerInterface exists exactly so tests can swap this in (banjax.go:119-123
+author comment).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from banjax_tpu.config.schema import Config
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.effectors.banner import BannerInterface
+
+
+@dataclasses.dataclass
+class RecordedBan:
+    ip: str
+    decision: Decision
+    domain: str
+
+
+class MockBanner(BannerInterface):
+    def __init__(self, dynamic_lists: Optional[DynamicDecisionLists] = None):
+        self.bans: List[RecordedBan] = []
+        self.regex_ban_logs: List[Tuple[str, str]] = []  # (ip, rule_name)
+        self.failed_challenge_ban_logs: List[Tuple[str, str]] = []  # (ip, type)
+        self.ipset: set = set()
+        self.dynamic_lists = dynamic_lists
+
+    def ban_or_challenge_ip(self, config: Config, ip: str, decision: Decision, domain: str) -> None:
+        self.bans.append(RecordedBan(ip, decision, domain))
+        if self.dynamic_lists is not None:
+            import time
+            self.dynamic_lists.update(
+                ip, time.time() + config.expiring_decision_ttl_seconds, decision, False, domain
+            )
+
+    def log_regex_ban(self, config, log_time_unix, ip, rule_name, log_line_rest, decision):
+        self.regex_ban_logs.append((ip, rule_name))
+
+    def log_failed_challenge_ban(self, config, ip, challenge_type, host, path,
+                                 too_many_failed_challenges_threshold, user_agent,
+                                 decision, method):
+        self.failed_challenge_ban_logs.append((ip, challenge_type))
+
+    def ipset_add(self, config: Config, ip: str) -> None:
+        self.ipset.add(ip)
+
+    def ipset_test(self, config: Config, ip: str) -> bool:
+        return ip in self.ipset
+
+    def ipset_list(self) -> list:
+        return sorted(self.ipset)
+
+    def ipset_del(self, ip: str) -> None:
+        self.ipset.discard(ip)

@@ -148,3 +148,35 @@ regexes_with_rates:
 """
     )
     assert cfg.regexes_with_rates[0].regex.search("(?=x")
+
+
+def test_wrong_typed_scalars_fail_load():
+    # Go yaml.v2 fails the load on type mismatches; so do we
+    with pytest.raises(ValueError):
+        config_from_yaml_text('sha_inv_expected_zero_bits: "10"')
+    with pytest.raises(ValueError):
+        config_from_yaml_text("iptables_ban_seconds: banana")
+    with pytest.raises(ValueError):
+        config_from_yaml_text("debug: 1")
+    with pytest.raises(ValueError):
+        config_from_yaml_text("kafka_brokers: not-a-list")
+
+
+def test_python311_only_regex_constructs_rejected():
+    # atomic groups and possessive quantifiers are RE2-invalid
+    for bad in [r"(?>abc)x", r"a*+b", r"a++", r"x{2,3}+"]:
+        with pytest.raises(ValueError):
+            config_from_yaml_text(
+                f"""
+regexes_with_rates:
+  - {{decision: allow, hits_per_interval: 1, interval: 1, regex: '{bad}', rule: r}}
+"""
+            )
+    # a literal closing brace before + is valid RE2 and must pass
+    cfg = config_from_yaml_text(
+        """
+regexes_with_rates:
+  - {decision: allow, hits_per_interval: 1, interval: 1, regex: 'a}+', rule: r}
+"""
+    )
+    assert cfg.regexes_with_rates[0].regex.search("a}}}")

@@ -1,0 +1,408 @@
+"""The /auth_request decision chain (reference: internal/http_server.go:861-1136,
+integration cases from banjax_integration_test.go)."""
+
+import base64
+import hashlib
+import time
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.crypto.challenge import (
+    new_challenge_cookie,
+    parse_cookie,
+    solve_challenge_for_testing,
+)
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+from banjax_tpu.decisions.rate_limit import FailedChallengeRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.httpapi.decision_chain import (
+    ChainState,
+    DecisionListResult,
+    RequestInfo,
+    decision_for_nginx,
+)
+from tests.mock_banner import MockBanner
+
+
+CONFIG_YAML = r"""
+config_version: test-1
+global_decision_lists:
+  allow:
+    - 20.20.20.20
+  nginx_block:
+    - 70.80.90.100
+  challenge:
+    - 8.8.8.8
+per_site_decision_lists:
+  "example.com":
+    allow:
+      - 90.90.90.90
+    challenge:
+      - 91.91.91.91
+    nginx_block:
+      - 92.92.92.92
+per_site_user_agent_decision_lists:
+  "example.com":
+    allow:
+      - "GoodBot"
+global_user_agent_decision_lists:
+  nginx_block:
+    - "BadBot"
+password_protected_paths:
+  "example.com":
+    - wp-admin
+password_protected_path_exceptions:
+  "example.com":
+    - wp-admin/admin-ajax.php
+password_hashes:
+  "example.com": 5e884898da28047151d0e56f8dc6292773603d0d6aabbdd62a11ef721d1542d8
+sitewide_sha_inv_list:
+  shainv.com: block
+  noblock.com: no_block
+sha_inv_path_exceptions:
+  "example.com":
+    - /no_challenge
+sites_to_disable_baskerville:
+  nobask.com: false
+iptables_ban_seconds: 10
+kafka_brokers: [localhost:9092]
+server_log_file: /tmp/banjax-chain-test.log
+expiring_decision_ttl_seconds: 10
+too_many_failed_challenges_interval_seconds: 10
+too_many_failed_challenges_threshold: 2
+password_cookie_ttl_seconds: 14400
+sha_inv_cookie_ttl_seconds: 14400
+sha_inv_expected_zero_bits: 10
+hmac_secret: secret
+session_cookie_hmac_secret: session_secret
+session_cookie_ttl_seconds: 3600
+disable_kafka: true
+"""
+
+
+def load_config(yaml_text):
+    """config_from_yaml_text + the page-embed step ConfigHolder would do."""
+    from banjax_tpu.config.holder import _PAGES_DIR
+
+    config = config_from_yaml_text(yaml_text)
+    config.challenger_bytes = (_PAGES_DIR / "sha-inverse-challenge.html").read_bytes()
+    config.password_page_bytes = (_PAGES_DIR / "password-protected-path.html").read_bytes()
+    return config
+
+
+@pytest.fixture()
+def state():
+    config = load_config(CONFIG_YAML)
+    dynamic = DynamicDecisionLists(start_sweeper=False)
+    return ChainState(
+        config=config,
+        static_lists=StaticDecisionLists(config),
+        dynamic_lists=dynamic,
+        protected_paths=PasswordProtectedPaths(config),
+        failed_challenge_states=FailedChallengeRateLimitStates(),
+        banner=MockBanner(dynamic),
+    )
+
+
+def req(ip="1.1.1.1", host="nothing.com", path="/", ua="mozilla", method="GET", cookies=None):
+    return RequestInfo(
+        client_ip=ip, requested_host=host, requested_path=path,
+        client_user_agent=ua, method=method, cookies=cookies or {},
+    )
+
+
+def solved_sha_cookie(config, binding):
+    fresh = new_challenge_cookie(config.hmac_secret, 100, binding)
+    return solve_challenge_for_testing(fresh, 10)
+
+
+def solved_password_cookie(config, binding, password=b"password"):
+    fresh = new_challenge_cookie(config.hmac_secret, 100, binding)
+    hmac_b, _, expiry = parse_cookie(fresh)
+    solution = hashlib.sha256(hmac_b + hashlib.sha256(password).digest()).digest()
+    return base64.standard_b64encode(hmac_b + solution + expiry).decode()
+
+
+# ---- default allow ----
+
+def test_no_mention_access_granted(state):
+    resp, result = decision_for_nginx(state, req())
+    assert resp.status == 200
+    assert resp.headers["X-Accel-Redirect"] == "@access_granted"
+    assert resp.headers["X-Banjax-Decision"] == "NoMention"
+    assert result.decision_list_result is DecisionListResult.NO_MENTION
+    # session cookie issued on every response
+    assert resp.headers["X-Deflect-Session-New"] == "true"
+    assert any(c.name == "deflect_session" for c in resp.cookies)
+
+
+# ---- static IP lists ----
+
+def test_global_allow(state):
+    resp, result = decision_for_nginx(state, req(ip="20.20.20.20"))
+    assert resp.status == 200
+    assert result.decision_list_result is DecisionListResult.GLOBAL_ACCESS_GRANTED
+
+
+def test_global_block(state):
+    resp, result = decision_for_nginx(state, req(ip="70.80.90.100"))
+    assert resp.status == 403
+    assert resp.headers["X-Accel-Redirect"] == "@access_denied"
+    assert result.decision_list_result is DecisionListResult.GLOBAL_BLOCK
+
+
+def test_global_challenge_serves_page(state):
+    resp, result = decision_for_nginx(state, req(ip="8.8.8.8"))
+    assert resp.status == 429
+    assert result.decision_list_result is DecisionListResult.GLOBAL_CHALLENGE
+    assert b"new_solver(10)" in resp.body  # config difficulty == page default
+    assert b"max-age=14400" in resp.body  # rewrite applied
+    assert any(c.name == "deflect_challenge3" for c in resp.cookies)
+
+
+def test_global_challenge_passes_with_solved_cookie(state):
+    cookie = solved_sha_cookie(state.config, "8.8.8.8")
+    resp, result = decision_for_nginx(
+        state, req(ip="8.8.8.8", cookies={"deflect_challenge3": cookie})
+    )
+    assert resp.status == 200
+    assert resp.headers["X-Banjax-Decision"] == "ShaChallengePassed"
+    # integrity bot-score headers are emitted on sha-challenge outcomes
+    assert resp.headers["X-Banjax-Bot-Score"] == "1.000000"
+    assert resp.headers["X-Banjax-Bot-Score-Top-Factor"] == "no_payload"
+
+
+def test_per_site_beats_global(state):
+    # 90.90.90.90 allowed on example.com even though not in global
+    resp, result = decision_for_nginx(state, req(ip="90.90.90.90", host="example.com"))
+    assert result.decision_list_result is DecisionListResult.PER_SITE_ACCESS_GRANTED
+    resp, result = decision_for_nginx(state, req(ip="92.92.92.92", host="example.com"))
+    assert result.decision_list_result is DecisionListResult.PER_SITE_BLOCK
+
+
+# ---- UA lists ----
+
+def test_per_site_ua_allow_overrides_global_ip_challenge(state):
+    # reference integration case (banjax_integration_test.go:409-463):
+    # per-site IP list is checked BEFORE per-site UA... but a per-site UA
+    # allow fires before the GLOBAL IP challenge
+    resp, result = decision_for_nginx(
+        state, req(ip="8.8.8.8", host="example.com", ua="GoodBot/1.0")
+    )
+    assert result.decision_list_result is DecisionListResult.PER_SITE_UA_ACCESS_GRANTED
+
+
+def test_global_ip_challenge_fires_before_global_ua_block(state):
+    resp, result = decision_for_nginx(state, req(ip="8.8.8.8", ua="BadBot/1.0"))
+    assert result.decision_list_result is DecisionListResult.GLOBAL_CHALLENGE
+
+
+def test_global_ua_block(state):
+    resp, result = decision_for_nginx(state, req(ua="BadBot/1.0"))
+    assert resp.status == 403
+    assert result.decision_list_result is DecisionListResult.GLOBAL_UA_BLOCK
+
+
+# ---- password-protected paths ----
+
+def test_password_protected_path_serves_password_page(state):
+    resp, result = decision_for_nginx(state, req(host="example.com", path="/wp-admin/x"))
+    assert resp.status == 401
+    assert result.decision_list_result is DecisionListResult.PASSWORD_PROTECTED_PATH
+    assert any(c.name == "deflect_password3" for c in resp.cookies)
+    assert b"deflect_password3" in resp.body
+
+
+def test_password_protected_exception_passes(state):
+    resp, result = decision_for_nginx(
+        state, req(host="example.com", path="/wp-admin/admin-ajax.php")
+    )
+    assert resp.status == 200
+    assert result.decision_list_result is DecisionListResult.PASSWORD_PROTECTED_PATH_EXCEPTION
+
+
+def test_password_cookie_priority_pass(state):
+    # a valid password cookie passes even on a non-protected path/challenge IP
+    cookie = solved_password_cookie(state.config, "8.8.8.8")
+    resp, result = decision_for_nginx(
+        state, req(ip="8.8.8.8", host="example.com", cookies={"deflect_password3": cookie})
+    )
+    assert resp.status == 200
+    assert result.decision_list_result is DecisionListResult.PASSWORD_PROTECTED_PRIORITY_PASS
+
+
+def test_password_challenge_passes_with_valid_cookie(state):
+    cookie = solved_password_cookie(state.config, "5.5.5.5")
+    resp, result = decision_for_nginx(
+        state,
+        req(ip="5.5.5.5", host="example.com", path="/wp-admin/x",
+            cookies={"deflect_password3": cookie}),
+    )
+    assert resp.status == 200
+    assert resp.headers["X-Banjax-Decision"] == "PasswordProtectedPriorityPass"
+
+
+# ---- failed-challenge lockout (401,401,...,403) ----
+
+def test_too_many_failed_password_challenges_blocks(state):
+    # threshold=2: two fails are 401s, the third (hits=3 > 2) bans
+    r = req(ip="6.6.6.6", host="example.com", path="/wp-admin/x",
+            cookies={"deflect_password3": "garbage"})
+    statuses = []
+    for _ in range(3):
+        resp, result = decision_for_nginx(state, r)
+        statuses.append(resp.status)
+    assert statuses == [401, 401, 403]
+    banner = state.banner
+    assert banner.bans and banner.bans[0].ip == "6.6.6.6"
+    assert banner.bans[0].decision is Decision.IPTABLES_BLOCK
+    assert banner.failed_challenge_ban_logs[0] == ("6.6.6.6", "password")
+
+
+def test_allowlisted_ip_gets_nginx_block_not_iptables(state):
+    # per-site allow → failed challenges escalate to NginxBlock instead
+    r = req(ip="90.90.90.90", host="example.com", path="/wp-admin/x",
+            cookies={"deflect_password3": "garbage"})
+    for _ in range(3):
+        resp, _ = decision_for_nginx(state, r)
+    assert state.banner.bans[0].decision is Decision.NGINX_BLOCK
+
+
+# ---- expiring (dynamic) lists ----
+
+def test_expiring_challenge_and_path_exception(state):
+    state.dynamic_lists.update(
+        "3.3.3.3", time.time() + 60, Decision.CHALLENGE, False, "example.com"
+    )
+    resp, result = decision_for_nginx(state, req(ip="3.3.3.3", host="example.com"))
+    assert resp.status == 429
+    assert result.decision_list_result is DecisionListResult.EXPIRING_CHALLENGE
+
+    # sha_inv_path_exceptions passes straight through
+    resp, result = decision_for_nginx(
+        state, req(ip="3.3.3.3", host="example.com", path="/no_challenge/x")
+    )
+    assert resp.status == 200
+    assert result.decision_list_result is DecisionListResult.PER_SITE_SHA_INV_PATH_EXCEPTION
+
+
+def test_expiring_block(state):
+    state.dynamic_lists.update(
+        "4.4.4.4", time.time() + 60, Decision.NGINX_BLOCK, False, "x.com"
+    )
+    resp, result = decision_for_nginx(state, req(ip="4.4.4.4"))
+    assert resp.status == 403
+    assert result.decision_list_result is DecisionListResult.EXPIRING_BLOCK
+
+
+def test_baskerville_disabled_falls_through(state):
+    # baskerville-sourced block on a disabled site falls through to allow
+    state.dynamic_lists.update(
+        "5.5.5.5", time.time() + 60, Decision.NGINX_BLOCK, True, "nobask.com"
+    )
+    resp, result = decision_for_nginx(state, req(ip="5.5.5.5", host="nobask.com"))
+    assert resp.status == 200
+    assert result.decision_list_result is DecisionListResult.NO_MENTION
+
+    # but a non-baskerville block still blocks there
+    state.dynamic_lists.update(
+        "5.5.5.5", time.time() + 60, Decision.IPTABLES_BLOCK, False, "nobask.com"
+    )
+    resp, result = decision_for_nginx(state, req(ip="5.5.5.5", host="nobask.com"))
+    assert resp.status == 403
+
+
+def test_session_id_decision_applies(state):
+    from banjax_tpu.crypto.session import new_session_cookie
+    sess = new_session_cookie(
+        state.config.session_cookie_hmac_secret, 3600, "7.7.7.7"
+    )
+    state.dynamic_lists.update_by_session_id(
+        "7.7.7.7", sess, time.time() + 60, Decision.NGINX_BLOCK, True, "x.com"
+    )
+    resp, result = decision_for_nginx(
+        state, req(ip="7.7.7.7", cookies={"deflect_session": sess})
+    )
+    assert resp.status == 403
+    assert result.decision_list_result is DecisionListResult.EXPIRING_BLOCK
+
+
+# ---- sitewide SHA-inv ----
+
+def test_sitewide_sha_inv_challenges(state):
+    resp, result = decision_for_nginx(state, req(host="shainv.com"))
+    assert resp.status == 429
+    assert result.decision_list_result is DecisionListResult.SITE_WIDE_CHALLENGE
+
+
+def test_sitewide_no_block_keeps_challenging_on_failure(state):
+    # no_block fail action: failures never escalate to a ban
+    r = req(ip="9.9.9.9", host="noblock.com", cookies={"deflect_challenge3": "garbage"})
+    for _ in range(5):
+        resp, _ = decision_for_nginx(state, r)
+        assert resp.status == 429
+    assert state.banner.bans == []
+
+
+def test_sitewide_sha_inv_exception_via_password_exceptions(state):
+    cfg_yaml = CONFIG_YAML.replace(
+        'sitewide_sha_inv_list:\n  shainv.com: block',
+        'sitewide_sha_inv_list:\n  shainv.com: block\n  example.com: block',
+    )
+    config = load_config(cfg_yaml)
+    dynamic = DynamicDecisionLists(start_sweeper=False)
+    st = ChainState(
+        config=config,
+        static_lists=StaticDecisionLists(config),
+        dynamic_lists=dynamic,
+        protected_paths=PasswordProtectedPaths(config),
+        failed_challenge_states=FailedChallengeRateLimitStates(),
+        banner=MockBanner(dynamic),
+    )
+    resp, result = decision_for_nginx(
+        st, req(host="example.com", path="/wp-admin/admin-ajax.php")
+    )
+    # exception path: classified as PasswordProtectedPathException first
+    assert resp.status == 200
+
+
+def test_sha_challenge_solved_for_sitewide(state):
+    cookie = solved_sha_cookie(state.config, "2.2.2.2")
+    resp, result = decision_for_nginx(
+        state, req(ip="2.2.2.2", host="shainv.com", cookies={"deflect_challenge3": cookie})
+    )
+    assert resp.status == 200
+    assert resp.headers["X-Banjax-Decision"] == "ShaChallengePassed"
+
+
+# ---- use_user_agent_in_cookie binding ----
+
+def test_ua_bound_cookie():
+    config = load_config(
+        CONFIG_YAML + "\nuse_user_agent_in_cookie:\n  'uabound.com': true\n"
+    )
+    dynamic = DynamicDecisionLists(start_sweeper=False)
+    st = ChainState(
+        config=config,
+        static_lists=StaticDecisionLists(config),
+        dynamic_lists=dynamic,
+        protected_paths=PasswordProtectedPaths(config),
+        failed_challenge_states=FailedChallengeRateLimitStates(),
+        banner=MockBanner(dynamic),
+    )
+    # cookie bound to the UA, not the IP: solving with UA binding passes even
+    # if the IP changes
+    fresh = new_challenge_cookie(config.hmac_secret, 100, "special-agent")
+    cookie = solve_challenge_for_testing(fresh, 10)
+    dynamic.update("1.2.3.4", time.time() + 60, Decision.CHALLENGE, False, "uabound.com")
+    dynamic.update("5.6.7.8", time.time() + 60, Decision.CHALLENGE, False, "uabound.com")
+    for ip in ("1.2.3.4", "5.6.7.8"):
+        resp, _ = decision_for_nginx(
+            st,
+            req(ip=ip, host="uabound.com", ua="special-agent",
+                cookies={"deflect_challenge3": cookie}),
+        )
+        assert resp.status == 200

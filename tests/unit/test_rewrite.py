@@ -1,0 +1,54 @@
+"""Challenge-page HTML rewriting (reference: internal/http_server_test.go,
+http_server.go:438-491)."""
+
+from banjax_tpu.config.holder import _PAGES_DIR
+from banjax_tpu.config.schema import Config
+from banjax_tpu.httpapi.rewrite import (
+    apply_args_to_password_page,
+    apply_args_to_sha_inv_page,
+    apply_cookie_domain,
+    apply_cookie_max_age,
+)
+
+
+def sha_page() -> bytes:
+    return (_PAGES_DIR / "sha-inverse-challenge.html").read_bytes()
+
+
+def password_page() -> bytes:
+    return (_PAGES_DIR / "password-protected-path.html").read_bytes()
+
+
+def test_sha_page_difficulty_rewrite_hits_the_onload():
+    config = Config(
+        challenger_bytes=sha_page(),
+        sha_inv_cookie_ttl_seconds=14400,
+        sha_inv_expected_zero_bits=13,  # non-default so a comment hit would show
+    )
+    out = apply_args_to_sha_inv_page(config)
+    assert b'onload="new_solver(13)"' in out
+    assert b"new_solver(10)" not in out
+    assert b'"deflect_challenge3=" + base64_cookie + ";max-age=14400"' in out
+
+
+def test_password_page_max_age_and_domain():
+    out = apply_args_to_password_page(password_page(), roaming=False, cookie_ttl=3600)
+    assert b'"deflect_password3=" + base64_cookie + ";max-age=3600"' in out
+    assert b"window.location.hostname" not in out
+
+    out = apply_args_to_password_page(password_page(), roaming=True, cookie_ttl=3600)
+    assert b';domain=" + window.location.hostname' in out
+
+
+def test_rewrite_replaces_first_occurrence_only():
+    page = b'x "c=" + base64_cookie y "c=" + base64_cookie z'
+    out = apply_cookie_max_age(page, "c", 5)
+    assert out == b'x "c=" + base64_cookie + ";max-age=5" y "c=" + base64_cookie z'
+
+
+def test_rewrite_targets_unique_in_shipped_pages():
+    # the server patches the FIRST occurrence; the target strings must appear
+    # exactly once, inside the JS (a doc comment above the JS once broke this)
+    assert sha_page().count(b'"deflect_challenge3=" + base64_cookie') == 1
+    assert sha_page().count(b"new_solver(10)") == 1
+    assert password_page().count(b'"deflect_password3=" + base64_cookie') == 1
