@@ -190,9 +190,14 @@ class Banner(BannerInterface):
             self._ipset.add(ip, config.iptables_ban_seconds)
 
     def ipset_test(self, config: Config, ip: str) -> bool:
+        # iptables.go:300-303: `banned, _ := b.IPSetInstance.Test(ip)` —
+        # errors are ignored and read as "not banned"
         if self._ipset is None:
             return False
-        return self._ipset.test(ip)
+        try:
+            return self._ipset.test(ip)
+        except Exception:  # noqa: BLE001 — mirror the ignored error
+            return False
 
     def ipset_list(self) -> List[str]:
         if self._ipset is None:

@@ -182,7 +182,9 @@ def build_app(deps: ServerDeps) -> web.Application:
                 deps.server_log_file.flush()
             return await handler(request)
 
-        middlewares.append(standalone_middleware)
+        # outermost, so the injected X-* headers are visible to the access
+        # log (the reference mutates the shared header map in place)
+        middlewares.insert(0, standalone_middleware)
 
     app = web.Application(middlewares=middlewares)
 
@@ -244,7 +246,10 @@ def build_app(deps: ServerDeps) -> web.Application:
         ip = request.query.get("ip", "")
         if not ip:
             return web.json_response({"error": "ip query param is required"}, status=400)
-        banned = deps.banner.ipset_list()
+        try:
+            banned = deps.banner.ipset_list()
+        except Exception:  # noqa: BLE001 — reference ignores the error (banned, _ :=)
+            banned = None
         expiring, ok = deps.dynamic_lists.check("", ip)
         if not ok:
             return web.json_response(

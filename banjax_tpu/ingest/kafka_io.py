@@ -147,9 +147,11 @@ def handle_command(config: Config, command: dict, decision_lists: DynamicDecisio
     host = command.get("host", "")
     name = command.get("Name", "")
 
-    if host in config.sites_to_disable_baskerville:
-        if config.debug:
-            log.info("KAFKA: %s disabled baskerville, skipping %s", host, name)
+    # reference quirk (kafka.go:200-203): the skip-and-return only fires when
+    # the host is disabled AND debug is on; in production the command is
+    # stored and neutralized at serve time by the DIS-BASK chain check
+    if host in config.sites_to_disable_baskerville and config.debug:
+        log.info("KAFKA: %s disabled baskerville, skipping %s", host, name)
         return
 
     if name == "challenge_ip":

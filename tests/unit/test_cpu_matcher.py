@@ -1,0 +1,242 @@
+"""CPU reference matcher (reference: internal/regex_rate_limiter_test.go)."""
+
+import random
+import string
+import time
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from tests.mock_banner import MockBanner
+
+
+CONFIG_YAML = r"""
+regexes_with_rates:
+  - decision: nginx_block
+    rule: 'rule1'
+    regex: 'GET example\.com GET .*'
+    interval: 5
+    hits_per_interval: 2
+  - decision: challenge
+    rule: 'rule2'
+    regex: 'POST .*'
+    interval: 5
+    hits_per_interval: 1
+per_site_regexes_with_rates:
+  per-site.com:
+    - decision: nginx_block
+      hits_per_interval: 0
+      interval: 1
+      regex: .*blockme.*
+      rule: "instant block"
+global_decision_lists:
+  allow:
+    - 12.12.12.12
+"""
+
+
+def make_matcher(yaml_text=CONFIG_YAML):
+    config = config_from_yaml_text(yaml_text)
+    states = RegexRateLimitStates()
+    banner = MockBanner()
+    matcher = CpuMatcher(config, banner, StaticDecisionLists(config), states)
+    return matcher, states, banner
+
+
+def line(ts, ip="1.2.3.4", rest="GET example.com GET /whatever HTTP/1.1 Chrome/51 -"):
+    return f"{ts:f} {ip} {rest}"
+
+
+def test_window_transitions_via_consume_line():
+    """The window-start / in-window / window-restart sequence
+    (regex_rate_limiter_test.go:77-260)."""
+    matcher, states, banner = make_matcher()
+    now = time.time()
+
+    matcher.consume_line(line(now))
+    ip_states, ok = states.get("1.2.3.4")
+    assert ok and ip_states["rule1"].num_hits == 1
+    assert banner.bans == []
+
+    matcher.consume_line(line(now + 4))
+    ip_states, _ = states.get("1.2.3.4")
+    assert ip_states["rule1"].num_hits == 2
+    assert banner.bans == []
+
+    # just past the 5s interval → window restarts
+    matcher.consume_line(line(now + 5.5))
+    ip_states, _ = states.get("1.2.3.4")
+    assert ip_states["rule1"].num_hits == 1
+    assert banner.bans == []
+
+    # a POST trips rule2 (hits_per_interval=1) but not yet over
+    matcher.consume_line(line(now + 6.5, rest="POST example.com POST /x HTTP/1.1 UA -"))
+    ip_states, _ = states.get("1.2.3.4")
+    assert ip_states["rule1"].num_hits == 1  # unchanged, regex didn't match
+    assert ip_states["rule2"].num_hits == 1
+    assert banner.bans == []
+
+    # second POST inside the window: hits=2 > 1 → ban
+    matcher.consume_line(line(now + 7.0, rest="POST example.com POST /x HTTP/1.1 UA -"))
+    assert len(banner.bans) == 1
+    assert banner.bans[0].ip == "1.2.3.4"
+    assert banner.bans[0].decision is Decision.CHALLENGE
+    assert banner.regex_ban_logs == [("1.2.3.4", "rule2")]
+
+
+def test_malformed_lines_error():
+    matcher, _, _ = make_matcher()
+    assert matcher.consume_line("one two").error
+    assert matcher.consume_line("notafloat 1.2.3.4 GET x GET / U").error
+    assert matcher.consume_line(f"{time.time():f} 1.2.3.4 onlyoneword").error
+
+
+def test_old_lines_dropped():
+    matcher, states, _ = make_matcher()
+    result = matcher.consume_line(line(time.time() - 11))
+    assert result.old_line
+    _, ok = states.get("1.2.3.4")
+    assert not ok
+
+
+def test_allowlisted_ip_exempted():
+    matcher, states, banner = make_matcher()
+    result = matcher.consume_line(
+        line(time.time(), ip="12.12.12.12", rest="GET example.com GET /blockme HTTP/1.1 U -")
+    )
+    assert result.exempted
+    _, ok = states.get("12.12.12.12")
+    assert not ok
+
+
+def test_per_site_rules_apply_before_global():
+    matcher, _, banner = make_matcher()
+    result = matcher.consume_line(
+        line(time.time(), rest="GET per-site.com GET /blockme HTTP/1.1 U -")
+    )
+    names = [r.rule_name for r in result.rule_results]
+    assert names[0] == "instant block"  # per-site first
+    assert banner.bans[0].decision is Decision.NGINX_BLOCK
+    assert banner.bans[0].domain == "per-site.com"
+
+
+def test_hosts_to_skip():
+    yaml_text = """
+regexes_with_rates:
+  - decision: challenge
+    hits_per_interval: 0
+    interval: 1
+    regex: .*
+    rule: "challenge all"
+    hosts_to_skip:
+      skipme.com: true
+"""
+    matcher, _, banner = make_matcher(yaml_text)
+    result = matcher.consume_line(
+        line(time.time(), rest="GET skipme.com GET / HTTP/1.1 U -")
+    )
+    assert result.rule_results[0].skip_host
+    assert banner.bans == []
+
+    result = matcher.consume_line(
+        line(time.time(), rest="GET other.com GET / HTTP/1.1 U -")
+    )
+    assert not result.rule_results[0].skip_host
+    assert len(banner.bans) == 1
+
+
+def test_per_site_stress_each_line_trips_its_own_rule():
+    """Generative stress (TestPerSiteRegexStress, regex_rate_limiter_test.go:
+    298-360): N generated per-site rules, each line trips exactly its rule."""
+    rng = random.Random(42)
+    n = 400
+    sites = []
+    rule_lines = []
+    for i in range(n):
+        site = f"site{i}.example"
+        token = "".join(rng.choices(string.ascii_lowercase, k=12))
+        sites.append((site, token))
+        rule_lines.append(
+            f"  {site}:\n"
+            f"    - decision: nginx_block\n"
+            f"      hits_per_interval: 0\n"
+            f"      interval: 1\n"
+            f"      regex: .*{token}.*\n"
+            f'      rule: "rule-{site}"\n'
+        )
+    yaml_text = "per_site_regexes_with_rates:\n" + "".join(rule_lines)
+    matcher, _, banner = make_matcher(yaml_text)
+
+    now = time.time()
+    for i, (site, token) in enumerate(sites):
+        ip = f"10.{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}"
+        result = matcher.consume_line(
+            line(now, ip=ip, rest=f"GET {site} GET /{token} HTTP/1.1 U -")
+        )
+        fired = [r.rule_name for r in result.rule_results]
+        assert fired == [f"rule-{site}"], f"line {i} fired {fired}"
+    assert len(banner.bans) == n
+
+
+def test_kafka_command_dispatch():
+    """kafka.go:194-283 command handling through the shared dynamic lists."""
+    from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+    from banjax_tpu.ingest.kafka_io import handle_command
+
+    config = config_from_yaml_text(
+        CONFIG_YAML
+        + """
+expiring_decision_ttl_seconds: 100
+block_ip_ttl_seconds: 50
+block_session_ttl_seconds: 60
+sites_to_disable_baskerville:
+  disabled.com: true
+"""
+    )
+    lists = DynamicDecisionLists(start_sweeper=False)
+
+    handle_command(config, {"Name": "challenge_ip", "Value": "1.2.3.4", "host": "a.com"}, lists)
+    ed, ok = lists.check("", "1.2.3.4")
+    assert ok and ed.decision is Decision.CHALLENGE and ed.from_baskerville
+
+    handle_command(config, {"Name": "block_ip", "Value": "5.6.7.8", "host": "a.com"}, lists)
+    ed, ok = lists.check("", "5.6.7.8")
+    assert ok and ed.decision is Decision.NGINX_BLOCK
+    # reference quirk: block_ip ttl defaults to block_session_ttl_seconds (60)
+    assert ed.expires == pytest.approx(time.time() + 60, abs=2)
+
+    handle_command(
+        config,
+        {"Name": "block_session", "Value": "9.9.9.9", "host": "a.com",
+         "session_id": "sess%2Bid"},
+        lists,
+    )
+    ed, ok = lists.check("sess+id", "0.0.0.0")  # url-decoded id is the key
+    assert ok and ed.decision is Decision.NGINX_BLOCK
+    # and block_session ttl defaults to block_ip_ttl_seconds (50)
+    assert ed.expires == pytest.approx(time.time() + 50, abs=2)
+
+    # reference quirk: disabled-baskerville hosts are only skipped when
+    # debug is ALSO on; production stores the command (neutralized at serve
+    # time by the chain's DIS-BASK check)
+    handle_command(
+        config, {"Name": "block_ip", "Value": "7.7.7.7", "host": "disabled.com"}, lists
+    )
+    _, ok = lists.check("", "7.7.7.7")
+    assert ok
+    config.debug = True
+    handle_command(
+        config, {"Name": "block_ip", "Value": "3.3.3.3", "host": "disabled.com"}, lists
+    )
+    _, ok = lists.check("", "3.3.3.3")
+    assert not ok
+    config.debug = False
+
+    # malformed (short) values are ignored
+    handle_command(config, {"Name": "block_ip", "Value": "1.2", "host": "a.com"}, lists)
+    _, ok = lists.check("", "1.2")
+    assert not ok
