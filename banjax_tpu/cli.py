@@ -86,7 +86,7 @@ class BanjaxApp:
 
         self._matcher = None
         self._matcher_generation = -1
-        self.tailer = LogTailer(config.server_log_file, self._consume_line)
+        self.tailer = LogTailer(config.server_log_file, self._consume_lines)
 
         self.kafka_reader: Optional[KafkaReader] = None
         self.kafka_writer: Optional[KafkaWriter] = None
@@ -127,7 +127,7 @@ class BanjaxApp:
         self.dynamic_lists.clear()
         self.protected_paths.update_from_config(new_config)
 
-    def _consume_line(self, line_text: str) -> None:
+    def _current_matcher(self):
         # rebuilt on config change so rules hot-reload (regex_rate_limiter.go:59)
         cfg = self.config_holder.get()
         if self._matcher_generation != self.config_holder.generation:
@@ -137,9 +137,14 @@ class BanjaxApp:
                 cfg, self.banner, self.static_lists, self.regex_states
             )
             self._matcher_generation = self.config_holder.generation
-        result = self._matcher.consume_line(line_text)
+        return cfg, self._matcher
+
+    def _consume_lines(self, lines) -> None:
+        cfg, matcher = self._current_matcher()
+        results = matcher.consume_lines(lines)
         if cfg.debug:
-            log.debug("consumeLine: %s", result)
+            for result in results:
+                log.debug("consumeLine: %s", result)
 
     def start_workers(self) -> None:
         """Launch tailer, Kafka, metrics, heartbeat (not the HTTP server)."""

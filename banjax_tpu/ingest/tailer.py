@@ -16,7 +16,7 @@ import logging
 import os
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 log = logging.getLogger(__name__)
 
@@ -25,11 +25,18 @@ POLL_SECONDS = 0.05
 
 
 class LogTailer:
-    """Calls `on_line(text)` for every new line appended to `path`."""
+    """Calls `on_lines(batch)` with every read chunk's complete lines.
 
-    def __init__(self, path: str, on_line: Callable[[str], None]):
+    Batch delivery is the natural feed for the batched TPU matcher: the
+    faster the log grows, the bigger the device batches get, which is
+    exactly the load shape the batch path is built for. The serial CPU
+    matcher consumes the same batches line by line (Matcher.consume_lines'
+    default), preserving the reference's per-line semantics.
+    """
+
+    def __init__(self, path: str, on_lines: Callable[[List[str]], None]):
         self.path = path
-        self.on_line = on_line
+        self.on_lines = on_lines
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -69,13 +76,16 @@ class LogTailer:
             chunk = f.read()
             if chunk:
                 buffer += chunk
+                batch: List[str] = []
                 while "\n" in buffer:
                     line, buffer = buffer.split("\n", 1)
                     if line:
-                        try:
-                            self.on_line(line)
-                        except Exception:  # noqa: BLE001 — one bad line must not kill the tailer
-                            log.exception("error consuming log line")
+                        batch.append(line)
+                if batch:
+                    try:
+                        self.on_lines(batch)
+                    except Exception:  # noqa: BLE001 — a bad batch must not kill the tailer
+                        log.exception("error consuming log line batch")
                 continue
 
             # idle: check rotation/truncation

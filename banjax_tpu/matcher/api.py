@@ -17,9 +17,12 @@ Both must produce byte-identical Decision streams for the same input.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import List, Optional
 
 from banjax_tpu.decisions.rate_limit import RateLimitResult
+
+_log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -48,6 +51,21 @@ class Matcher:
 
     def consume_line(self, line_text: str, now_unix: Optional[float] = None) -> ConsumeLineResult:
         raise NotImplementedError
+
+    def consume_lines(
+        self, lines: List[str], now_unix: Optional[float] = None
+    ) -> List[ConsumeLineResult]:
+        """Batch entry point. The TPU matcher overrides this with one device
+        pass per batch; the default preserves the serial reference semantics,
+        including per-line fault isolation (one bad line loses only itself)."""
+        results = []
+        for line in lines:
+            try:
+                results.append(self.consume_line(line, now_unix))
+            except Exception:  # noqa: BLE001 — isolate faults per line
+                _log.exception("error consuming log line")
+                results.append(ConsumeLineResult(error=True))
+        return results
 
     def close(self) -> None:
         """Flush any buffered device batches (no-op for the CPU matcher)."""

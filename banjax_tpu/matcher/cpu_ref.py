@@ -27,17 +27,11 @@ from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
 from banjax_tpu.decisions.static_lists import StaticDecisionLists
 from banjax_tpu.effectors.banner import BannerInterface
 from banjax_tpu.matcher.api import ConsumeLineResult, Matcher, RuleResult
+from banjax_tpu.matcher.encode import parse_line
 
 log = logging.getLogger(__name__)
 
 OLD_LINE_CUTOFF_SECONDS = 10  # regex_rate_limiter.go:164
-
-
-def parse_timestamp_ns(timestamp_str: str) -> int:
-    """parseTimestamp (regex_rate_limiter.go:95-103): float seconds → int ns
-    via the same float64 multiply-then-truncate Go performs."""
-    seconds = float(timestamp_str)  # raises ValueError like Go's ParseFloat errors
-    return int(seconds * 1e9)
 
 
 class CpuMatcher(Matcher):
@@ -57,40 +51,24 @@ class CpuMatcher(Matcher):
         result = ConsumeLineResult()
         config = self.config
 
-        time_ip_rest = line_text.split(" ", 2)
-        if len(time_ip_rest) < 3:
-            log.warning("expected at least 3 words in log line: %s", time_ip_rest)
-            result.error = True
-            return result
-
-        ip_string = time_ip_rest[1]
-        try:
-            timestamp_ns = parse_timestamp_ns(time_ip_rest[0])
-        except ValueError:
-            log.warning("could not parse a timestamp float")
-            result.error = True
-            return result
-
-        method_url_rest = time_ip_rest[2].split(" ", 2)
-        if len(method_url_rest) < 3:
-            log.warning("expected at least method, url, rest")
-            result.error = True
-            return result
-        url_string = method_url_rest[1]
-
         now = time.time() if now_unix is None else now_unix
-        if now - timestamp_ns / 1e9 > OLD_LINE_CUTOFF_SECONDS:
+        p = parse_line(line_text, now, OLD_LINE_CUTOFF_SECONDS)
+        if p.error:
+            log.warning("could not parse log line: %r", line_text)
+            result.error = True
+            return result
+        if p.old_line:
             result.old_line = True
             return result
 
-        if self.decision_lists.check_is_allowed(url_string, ip_string):
+        if self.decision_lists.check_is_allowed(p.host, p.ip):
             result.exempted = True
             return result
 
         # per-site rules for the host first (regex_rate_limiter.go:175-193)
-        for rule in config.per_site_regexes_with_rates.get(url_string, []):
+        for rule in config.per_site_regexes_with_rates.get(p.host, []):
             rule_result = self._apply_regex_to_log(
-                rule, time_ip_rest[2], timestamp_ns, ip_string, url_string
+                rule, p.rest, p.timestamp_ns, p.ip, p.host
             )
             if rule_result.regex_match:
                 result.rule_results.append(rule_result)
@@ -98,7 +76,7 @@ class CpuMatcher(Matcher):
         # then global rules (regex_rate_limiter.go:195-211)
         for rule in config.regexes_with_rates:
             rule_result = self._apply_regex_to_log(
-                rule, time_ip_rest[2], timestamp_ns, ip_string, url_string
+                rule, p.rest, p.timestamp_ns, p.ip, p.host
             )
             if rule_result.regex_match:
                 result.rule_results.append(rule_result)

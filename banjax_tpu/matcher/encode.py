@@ -1,0 +1,96 @@
+"""Host-side encoding: raw log lines → device tensors + parsed metadata.
+
+The reference's consumeLine does all of this serially per line
+(/root/reference/internal/regex_rate_limiter.go:113-172): split
+"<epoch.frac> <ip> <rest>", parse the timestamp, split rest into
+"<method> <host> <rest2>", drop stale lines, skip allowlisted IPs. The TPU
+matcher performs the same parse on the host for a whole batch, then encodes
+each matchable line's `rest` into byte-class ids (classes computed by the
+rule compiler) for the device NFA pass.
+
+Lines the device cannot decide route to the host regex path instead:
+  * longer than the padded line length (truncation could lose a match);
+  * containing non-ASCII bytes (Go/Python regexes are rune-based there,
+    the device automaton is byte-based — route around the divergence).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from banjax_tpu.matcher.rulec import CompiledRules
+
+
+@dataclasses.dataclass
+class ParsedLine:
+    """consumeLine's per-line fields (regex_rate_limiter.go:126-157)."""
+
+    error: bool = False
+    old_line: bool = False
+    timestamp_ns: int = 0
+    ip: str = ""
+    host: str = ""
+    rest: str = ""  # "<method> <host> <rest2>" — the regex haystack
+
+
+def parse_line(line_text: str, now_unix: float, old_cutoff_seconds: float = 10.0) -> ParsedLine:
+    """The exact split/parse/staleness sequence of consumeLine.
+
+    This is the single source of the parse semantics — CpuMatcher and
+    TpuMatcher both consume it, so the two paths cannot drift.
+    """
+    p = ParsedLine()
+    time_ip_rest = line_text.split(" ", 2)
+    if len(time_ip_rest) < 3:
+        p.error = True
+        return p
+    try:
+        # Go float64-multiply truncation; nan/inf timestamps are parse errors
+        p.timestamp_ns = int(float(time_ip_rest[0]) * 1e9)
+    except (ValueError, OverflowError):
+        p.error = True
+        return p
+    p.ip = time_ip_rest[1]
+    method_url_rest = time_ip_rest[2].split(" ", 2)
+    if len(method_url_rest) < 3:
+        p.error = True
+        return p
+    p.host = method_url_rest[1]
+    p.rest = time_ip_rest[2]
+    if now_unix - p.timestamp_ns / 1e9 > old_cutoff_seconds:
+        p.old_line = True
+    return p
+
+
+def encode_for_match(
+    compiled: CompiledRules,
+    lines: Sequence[Union[str, bytes]],
+    max_len: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Encode byte strings → (cls_ids [B, max_len], lens [B], host_eval [B]).
+
+    Pad bytes get class 0, whose b_table row is all zeros, so device state
+    collapses past end-of-line with no explicit length masking.
+    """
+    B = len(lines)
+    cls_ids = np.zeros((B, max_len), dtype=np.int32)
+    lens = np.zeros(B, dtype=np.int32)
+    host_eval = np.zeros(B, dtype=bool)
+    table = compiled.byte_to_class
+    for i, raw in enumerate(lines):
+        if isinstance(raw, str):
+            raw = raw.encode("utf-8", "surrogatepass")
+        n = len(raw)
+        if n > max_len:
+            host_eval[i] = True
+            continue
+        arr = np.frombuffer(raw, dtype=np.uint8)
+        if n and arr.max() > 0x7F:
+            host_eval[i] = True
+            continue
+        cls_ids[i, :n] = table[arr]
+        lens[i] = n
+    return cls_ids, lens, host_eval

@@ -1,0 +1,205 @@
+"""TpuMatcher: the batched device-backed implementation of the Matcher seam.
+
+Pipeline per batch (SURVEY.md §7.1 / BASELINE.json north star):
+
+  host parse (encode.parse_line, the exact consumeLine splits)
+    → byte-class encode → device NFA match (nfa_jax.match_batch: all lines ×
+      all rules in one jitted shift-and scan)
+    → host fixed-window pass in original line order (the authoritative
+      RegexRateLimitStates — byte-identical window semantics by construction)
+    → Banner side effects (BanOrChallengeIp + LogRegexBan), identical call
+      sequence to the CPU reference path.
+
+The device decides only the regex-match bitmap — the O(lines × rules) hot
+loop of /root/reference/internal/regex_rate_limiter.go:234. Rule/line cases
+the device can't decide exactly (rules rulec can't lower; non-ASCII or
+over-length lines) fall back to host `re` per rule or per line, so the
+observable Decision stream is byte-identical to CpuMatcher for any input.
+
+Selected by `matcher: tpu` in banjax-config.yaml (the Matcher interface
+flag named in BASELINE.json); CpuMatcher remains the default.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from banjax_tpu.config.schema import Config, RegexWithRate
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.effectors.banner import BannerInterface
+from banjax_tpu.matcher import nfa_jax
+from banjax_tpu.matcher.api import ConsumeLineResult, Matcher, RuleResult
+from banjax_tpu.matcher.cpu_ref import OLD_LINE_CUTOFF_SECONDS
+from banjax_tpu.matcher.encode import ParsedLine, encode_for_match, parse_line
+from banjax_tpu.matcher.rulec import compile_rules
+
+log = logging.getLogger(__name__)
+
+_MIN_BUCKET = 64
+
+
+class TpuMatcher(Matcher):
+    def __init__(
+        self,
+        config: Config,
+        banner: BannerInterface,
+        decision_lists: StaticDecisionLists,
+        rate_limit_states: RegexRateLimitStates,
+        n_shards: int = 1,
+    ):
+        self.config = config
+        self.banner = banner
+        self.decision_lists = decision_lists
+        self.rate_limit_states = rate_limit_states
+
+        # Rule table: per-site rules first, then global — rule id i here is
+        # column i of the device match bitmap, end to end.
+        self._entries: List[Tuple[Optional[str], RegexWithRate]] = []
+        self._per_site_idx: Dict[str, List[int]] = {}
+        for site, rules in config.per_site_regexes_with_rates.items():
+            for r in rules:
+                self._per_site_idx.setdefault(site, []).append(len(self._entries))
+                self._entries.append((site, r))
+        self._global_idx: List[int] = []
+        for r in config.regexes_with_rates:
+            self._global_idx.append(len(self._entries))
+            self._entries.append((None, r))
+
+        self.compiled = compile_rules(
+            [r.regex_string for _, r in self._entries], n_shards=n_shards
+        )
+        for i, reason in self.compiled.unsupported.items():
+            log.info(
+                "rule %r falls back to the host regex path: %s",
+                self._entries[i][1].rule, reason,
+            )
+        self._host_rule_idx = [
+            i for i in range(len(self._entries)) if not self.compiled.device_ok[i]
+        ]
+        self._params = nfa_jax.match_params(self.compiled)
+        self._max_len = config.matcher_max_line_len
+        self._max_batch = max(_MIN_BUCKET, config.matcher_batch_lines)
+
+    # ---- Matcher API ----
+
+    def consume_line(self, line_text: str, now_unix: Optional[float] = None) -> ConsumeLineResult:
+        return self.consume_lines([line_text], now_unix)[0]
+
+    def consume_lines(
+        self, lines: Sequence[str], now_unix: Optional[float] = None
+    ) -> List[ConsumeLineResult]:
+        now = time.time() if now_unix is None else now_unix
+        results = [ConsumeLineResult() for _ in lines]
+
+        # 1. host parse + allowlist exemption (regex_rate_limiter.go:131-172)
+        work: List[Tuple[int, ParsedLine]] = []
+        for i, text in enumerate(lines):
+            p = parse_line(text, now, OLD_LINE_CUTOFF_SECONDS)
+            if p.error:
+                log.warning("could not parse log line: %r", text)
+                results[i].error = True
+                continue
+            if p.old_line:
+                results[i].old_line = True
+                continue
+            if self.decision_lists.check_is_allowed(p.host, p.ip):
+                results[i].exempted = True
+                continue
+            work.append((i, p))
+        if not work:
+            return results
+
+        # 2. device match bitmap for all matchable lines
+        bits = self._match_bits([p for _, p in work])
+
+        # 3. host window pass in original line order: per-site rules for the
+        #    line's host first, then global rules (regex_rate_limiter.go:175-211)
+        for row, (i, p) in enumerate(work):
+            rule_order = self._per_site_idx.get(p.host, []) + self._global_idx
+            try:
+                for idx in rule_order:
+                    _, rule = self._entries[idx]
+                    if not bits[row, idx]:
+                        continue
+                    results[i].rule_results.append(
+                        self._apply_matched_rule(rule, p)
+                    )
+            except Exception:  # noqa: BLE001 — a failing effector loses one line, not the batch
+                log.exception("error applying rules to log line")
+                results[i].error = True
+        return results
+
+    def close(self) -> None:
+        """No buffered state: consume_lines is synchronous per batch."""
+
+    # ---- internals ----
+
+    def _match_bits(self, parsed: List[ParsedLine]) -> np.ndarray:
+        """[N, n_rules] uint8 — exact regex-match bitmap for each line."""
+        n = len(parsed)
+        rests = [p.rest for p in parsed]
+        cls_ids, lens, host_eval = encode_for_match(self.compiled, rests, self._max_len)
+
+        bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
+        device_rows = np.flatnonzero(~host_eval)
+        for start in range(0, len(device_rows), self._max_batch):
+            rows = device_rows[start : start + self._max_batch]
+            b = _bucket(len(rows), self._max_batch)
+            pad_cls = np.zeros((b, self._max_len), dtype=np.int32)
+            pad_len = np.zeros(b, dtype=np.int32)
+            pad_cls[: len(rows)] = cls_ids[rows]
+            pad_len[: len(rows)] = lens[rows]
+            packed = np.asarray(
+                nfa_jax.match_batch_packed(
+                    self._params, pad_cls, pad_len, self.compiled.n_rules
+                )
+            )
+            out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
+            bits[rows] = out[: len(rows)]
+
+        # host fallback: whole lines the device can't decide
+        for row in np.flatnonzero(host_eval):
+            rest = rests[row]
+            for idx, (_, rule) in enumerate(self._entries):
+                if rule.regex.search(rest) is not None:
+                    bits[row, idx] = 1
+        # host fallback: rules the compiler couldn't lower
+        for idx in self._host_rule_idx:
+            rule = self._entries[idx][1]
+            for row in device_rows:
+                if rule.regex.search(rests[row]) is not None:
+                    bits[row, idx] = 1
+        return bits
+
+    def _apply_matched_rule(self, rule: RegexWithRate, p: ParsedLine) -> RuleResult:
+        """applyRegexToLog after a confirmed regex match
+        (regex_rate_limiter.go:240-269) — identical to cpu_ref."""
+        result = RuleResult(rule_name=rule.rule, regex_match=True)
+        if rule.hosts_to_skip.get(p.host):
+            result.skip_host = True
+            return result
+        result.skip_host = False
+        seen_ip, rate_limit_result = self.rate_limit_states.apply(
+            p.ip, rule, p.timestamp_ns
+        )
+        result.seen_ip = seen_ip
+        result.rate_limit_result = rate_limit_result
+        if rate_limit_result.exceeded:
+            self.banner.ban_or_challenge_ip(self.config, p.ip, rule.decision, p.host)
+            self.banner.log_regex_ban(
+                self.config, p.timestamp_ns / 1e9, p.ip, rule.rule, p.rest, rule.decision
+            )
+        return result
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Pad batch sizes to powers of two to bound jit recompiles."""
+    b = _MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return min(b, max(cap, _MIN_BUCKET))

@@ -1,0 +1,125 @@
+"""Device-mesh sharding for the batched NFA matcher.
+
+The reference scales horizontally by running N independent banjax+nginx
+edges with no shared state (SURVEY.md §2.3); the TPU-native equivalent is a
+`jax.sharding.Mesh` over two axes:
+
+  * `dp` — data parallel over the line batch: each device classifies a
+    shard of the encoded lines (the "log shards across cores" strategy of
+    BASELINE.json's "one pmap'd pass").
+  * `rp` — rule parallel over the packed NFA word axis: each device holds a
+    slice of the transition masks (the VMEM budget constraint of SURVEY.md
+    §7.3 hard part 3). rulec lays branches out so none straddles an `rp`
+    shard boundary, so the in-shard packed shift never needs a cross-device
+    carry; the only collective is one `psum` of accept bits over `rp`,
+    riding ICI.
+
+Windows/Decisions stay host-side (runner.py), so this module is the entire
+multi-chip device step — the thing `__graft_entry__.dryrun_multichip`
+compiles and runs on an N-virtual-device mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+from banjax_tpu.matcher import nfa_jax
+from banjax_tpu.matcher.rulec import CompiledRules
+
+
+def make_mesh(n_devices: int, rp: int = 1) -> Mesh:
+    """Mesh of shape (dp = n_devices // rp, rp)."""
+    if n_devices % rp != 0:
+        raise ValueError(f"n_devices {n_devices} not divisible by rp {rp}")
+    devices = np.array(jax.devices()[:n_devices]).reshape(n_devices // rp, rp)
+    return Mesh(devices, axis_names=("dp", "rp"))
+
+
+def _param_specs() -> Dict[str, P]:
+    return {
+        "b_table": P(None, "rp"),
+        "shift_in": P("rp"),
+        "inject_always": P("rp"),
+        "inject_start": P("rp"),
+        "selfloop": P("rp"),
+        "accept_any": P("rp"),
+        "accept_end": P("rp"),
+        # branch/extraction arrays are replicated; each rp member selects its
+        # own branches by word-index range
+        "acc_word": P(),
+        "acc_mask": P(),
+        "branch_rule": P(),
+        "always_match": P(),
+        "empty_only": P(),
+    }
+
+
+def sharded_match_fn(compiled: CompiledRules, mesh: Mesh):
+    """Build the jitted multi-device match step.
+
+    Returns fn(params, cls_ids [B, L], lens [B]) → matched [B, n_rules]
+    uint8, with B divisible by the dp axis size and compiled.n_shards equal
+    to the rp axis size.
+    """
+    rp = mesh.shape["rp"]
+    if compiled.n_shards != rp:
+        raise ValueError(
+            f"ruleset compiled for {compiled.n_shards} shards, mesh rp={rp}"
+        )
+    n_rules = compiled.n_rules
+    words_per_shard = compiled.words_per_shard
+
+    def local_step(params, cls_local, lens_local):
+        # state scan over this device's word slice only
+        acc = nfa_jax.nfa_scan(params, cls_local, lens_local)  # [b, W_local]
+        shard = jax.lax.axis_index("rp")
+        local_w = params["acc_word"] - shard * words_per_shard
+        in_shard = (local_w >= 0) & (local_w < words_per_shard)
+        gw = jnp.clip(local_w, 0, words_per_shard - 1)
+        sel = (acc[:, gw] & params["acc_mask"]) != 0  # [b, n_br]
+        sel = jnp.where(in_shard[None, :], sel, False)
+        # combine accept bits across the rule-parallel axis (ICI collective)
+        sel = jax.lax.psum(sel.astype(jnp.uint8), "rp")
+        b = cls_local.shape[0]
+        matched = jnp.zeros((b, n_rules), dtype=jnp.uint8)
+        if compiled.acc_word.shape[0] > 0:
+            matched = matched.at[:, params["branch_rule"]].max(
+                (sel > 0).astype(jnp.uint8)
+            )
+        matched = matched | params["always_match"].astype(jnp.uint8)[None, :]
+        empty = (lens_local == 0)[:, None].astype(jnp.uint8)
+        matched = matched | (params["empty_only"].astype(jnp.uint8)[None, :] * empty)
+        return matched
+
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(_param_specs(), P("dp", None), P("dp")),
+        out_specs=P("dp", None),
+        # the scan carry inside nfa_scan starts as a plain jnp.zeros; skip
+        # the varying-manual-axes check rather than pcast-ing the carry
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def shard_params(
+    compiled: CompiledRules, mesh: Mesh
+) -> Dict[str, jnp.ndarray]:
+    """Device-put the match params with the mesh sharding applied."""
+    params = nfa_jax.match_params(compiled)
+    specs = _param_specs()
+    return {
+        k: jax.device_put(v, jax.sharding.NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }

@@ -1,0 +1,179 @@
+"""Benchmark: log-lines/sec classified against 1k regex rules (BASELINE.json).
+
+Measures the device half of the TPU matcher — the batched NFA match that
+replaces the reference's serial per-(line, rule) regexp loop
+(/root/reference/internal/regex_rate_limiter.go:216-269) — on whatever
+accelerator is attached (the real TPU chip under the driver; CPU otherwise),
+plus the end-to-end TpuMatcher consume_lines path for context.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "lines/sec", "vs_baseline": N / 5e6}
+vs_baseline is against the BASELINE.md north-star target of 5M lines/sec
+@1k rules on v5e-1 (the reference itself publishes no numbers — see
+BASELINE.md; its serial Go loop is the functional, not numerical, baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import numpy as np
+
+
+N_RULES = 1000
+BATCH = 8192
+MAX_LEN = 128
+WARMUP = 3
+ITERS = 10
+
+
+def generate_rules(n: int, seed: int = 7) -> list:
+    """OWASP-CRS-shaped synthetic ruleset (BASELINE.json configs[2]):
+    literal attack paths, method+path prefixes, scanner UA tokens, char
+    classes and bounded quantifiers — the pattern shapes of
+    banjax-config.yaml's production rules."""
+    rng = random.Random(seed)
+    words = [
+        "admin", "login", "wp", "xmlrpc", "shell", "config", "backup", "env",
+        "passwd", "phpmyadmin", "setup", "install", "api", "token", "debug",
+        "console", "cgi", "bin", "upload", "include", "vendor", "composer",
+    ]
+    exts = ["php", "asp", "aspx", "jsp", "cgi", "sh", "bak", "sql", "old"]
+    patterns = []
+    while len(patterns) < n:
+        kind = rng.random()
+        w1, w2 = rng.choice(words), rng.choice(words)
+        ext = rng.choice(exts)
+        if kind < 0.3:
+            p = rf"GET /{w1}-{w2}/[a-z0-9_-]+\.{ext}"
+        elif kind < 0.5:
+            p = rf"(GET|POST) /{w1}/{w2}\.{ext}"
+        elif kind < 0.65:
+            p = rf"POST /{w1}[a-z]*/{w2}{rng.randint(0, 99)}"
+        elif kind < 0.8:
+            p = rf"/{w1}\.{ext}\?[a-z]+={rng.randint(0, 9)}[0-9]{{1,4}}"
+        elif kind < 0.9:
+            p = rf"(?i){w1}scan|{w2}bot/{rng.randint(1, 9)}\.[0-9]+"
+        else:
+            p = rf"^(GET|POST|HEAD) [a-z.-]+\.(com|org|net) .*/{w1}{w2}"
+        patterns.append(p)
+    return patterns
+
+
+def synthesize_match(pattern: str, rng: random.Random) -> str:
+    """Build a string the compiled rule actually matches (attack traffic)."""
+    from banjax_tpu.matcher.rulec import compile_rule
+
+    prog = compile_rule(pattern)
+    if not prog.branches:
+        return "GET example.com GET / HTTP/1.1 x -"
+    br = rng.choice(prog.branches)
+    chars = []
+    for pos in br.positions:
+        # prefer printable ASCII members of the byte class
+        for lo, hi in ((0x61, 0x7A), (0x30, 0x39), (0x20, 0x7E)):
+            cands = [b for b in range(lo, hi + 1) if (pos.cs >> b) & 1]
+            if cands:
+                break
+        chars.append(chr(rng.choice(cands or [0x61])))
+    body = "".join(chars)
+    prefix = "" if br.anchored_start else "GET example.com "
+    suffix = "" if br.anchored_end else " HTTP/1.1 ua -"
+    return prefix + body + suffix
+
+
+def generate_lines(n: int, patterns: list, seed: int = 11, attack_rate: float = 0.02) -> list:
+    """Mostly benign traffic with ~attack_rate lines synthesized to match a
+    random rule — the realistic shape of the tailer's input stream."""
+    rng = random.Random(seed)
+    hosts = ["example.com", "site.org", "news.net", "shop.com"]
+    paths = [
+        "/", "/index.html", "/assets/app.js", "/img/logo.png", "/about",
+        "/api/v1/items", "/search?q=red4321", "/contact", "/news/2026/07",
+    ]
+    uas = ["Mozilla/5.0 (X11; Linux x86_64)", "curl/8.1", "Safari/604.1"]
+    out = []
+    for _ in range(n):
+        if patterns and rng.random() < attack_rate:
+            out.append(synthesize_match(rng.choice(patterns), rng))
+            continue
+        method = rng.choice(["GET", "GET", "GET", "POST", "HEAD"])
+        out.append(
+            f"{method} {rng.choice(hosts)} {method} {rng.choice(paths)} "
+            f"HTTP/1.1 {rng.choice(uas)} -"
+        )
+    return out
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from banjax_tpu.matcher import nfa_jax
+    from banjax_tpu.matcher.encode import encode_for_match
+    from banjax_tpu.matcher.rulec import compile_rules
+
+    backend = jax.devices()[0].platform
+    patterns = generate_rules(N_RULES)
+
+    t0 = time.perf_counter()
+    compiled = compile_rules(patterns)
+    compile_s = time.perf_counter() - t0
+    n_device = int(compiled.device_ok.sum())
+
+    lines = generate_lines(BATCH, patterns)
+    cls_ids, lens, host_eval = encode_for_match(compiled, lines, MAX_LEN)
+    assert not host_eval.any()
+
+    params = nfa_jax.match_params(compiled)
+    cls_dev = jax.device_put(cls_ids)
+    lens_dev = jax.device_put(lens)
+
+    # device classification throughput: each iteration depends on the last
+    # (carry the popcount), so pipelined dispatch can't fake the timing
+    @jax.jit
+    def chained(s, cls, ln):
+        out = nfa_jax.match_batch(params, cls, ln, compiled.n_rules)
+        return s + out.astype(jnp.int32).sum()
+
+    t0 = time.perf_counter()
+    s = chained(jnp.int32(0), cls_dev, lens_dev)
+    s.block_until_ready()
+    first_call_s = time.perf_counter() - t0
+    for _ in range(WARMUP):
+        s = chained(s, cls_dev, lens_dev)
+    s.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        s = chained(s, cls_dev, lens_dev)
+    s.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    batch_latency_s = elapsed / ITERS
+    lines_per_sec = BATCH * ITERS / elapsed
+
+    out = np.asarray(
+        nfa_jax.match_batch(params, cls_dev, lens_dev, compiled.n_rules)
+    )
+    match_rate = float(out.any(axis=1).mean())
+
+    print(json.dumps({
+        "metric": "log-lines/sec classified @1k rules (device NFA match)",
+        "value": round(lines_per_sec, 1),
+        "unit": "lines/sec",
+        "vs_baseline": round(lines_per_sec / 5_000_000, 4),
+        "backend": backend,
+        "batch": BATCH,
+        "batch_latency_ms": round(batch_latency_s * 1e3, 2),
+        "rules_total": N_RULES,
+        "rules_on_device": n_device,
+        "nfa_words": compiled.n_words,
+        "rule_compile_s": round(compile_s, 2),
+        "first_call_s": round(first_call_s, 2),
+        "line_match_rate": round(match_rate, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,10 @@ pytest (bench.py).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the session may export JAX_PLATFORMS=axon (the real TPU
+# tunnel); tests must run on the 8-virtual-device CPU backend regardless —
+# bench.py is what runs on the real chip
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,3 +19,8 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the axon TPU plugin ignores the env var; the config knob wins
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,227 @@
+"""Differential harness: TpuMatcher vs CpuMatcher, byte-identical outputs.
+
+This is the end-to-end acceptance bar from BASELINE.json ("Decision output
+byte-identical to the Go path") and the generalization of the reference's
+generative stress test (regex_rate_limiter_test.go:298-360): identical
+ConsumeLineResult streams, identical Banner side-effect sequences, and
+identical rate-limit counter states for the same input line stream.
+"""
+
+import random
+import time
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from banjax_tpu.matcher.runner import TpuMatcher
+from tests.mock_banner import MockBanner
+
+
+CONFIG_YAML = r"""
+regexes_with_rates:
+  - decision: nginx_block
+    rule: 'rule1'
+    regex: 'GET example\.com GET .*'
+    interval: 5
+    hits_per_interval: 2
+  - decision: challenge
+    rule: 'rule2'
+    regex: 'POST .*'
+    interval: 5
+    hits_per_interval: 1
+  - decision: iptables_block
+    rule: 'skip-rule'
+    regex: 'DELETE '
+    interval: 5
+    hits_per_interval: 0
+    hosts_to_skip:
+      skipme.com: true
+per_site_regexes_with_rates:
+  per-site.com:
+    - decision: nginx_block
+      hits_per_interval: 0
+      interval: 1
+      regex: .*blockme.*
+      rule: "instant block"
+global_decision_lists:
+  allow:
+    - 12.12.12.12
+"""
+
+
+def make_pair(yaml_text=CONFIG_YAML):
+    """Two matchers over independent state, same config text."""
+    out = []
+    for cls in (CpuMatcher, TpuMatcher):
+        config = config_from_yaml_text(yaml_text)
+        states = RegexRateLimitStates()
+        banner = MockBanner()
+        matcher = cls(config, banner, StaticDecisionLists(config), states)
+        out.append((matcher, states, banner))
+    return out
+
+
+def result_key(r):
+    return (
+        r.error,
+        r.old_line,
+        r.exempted,
+        tuple(
+            (
+                rr.rule_name,
+                rr.regex_match,
+                rr.skip_host,
+                rr.seen_ip,
+                None
+                if rr.rate_limit_result is None
+                else (int(rr.rate_limit_result.match_type), rr.rate_limit_result.exceeded),
+            )
+            for rr in r.rule_results
+        ),
+    )
+
+
+def assert_identical_consumption(lines, yaml_text=CONFIG_YAML):
+    (cpu, cpu_states, cpu_banner), (tpu, tpu_states, tpu_banner) = make_pair(yaml_text)
+    now = time.time()
+    cpu_results = [cpu.consume_line(l, now_unix=now) for l in lines]
+    tpu_results = tpu.consume_lines(lines, now_unix=now)
+    for i, (a, b) in enumerate(zip(cpu_results, tpu_results)):
+        assert result_key(a) == result_key(b), f"line {i}: {lines[i]!r}"
+    assert [(b.ip, b.decision, b.domain) for b in cpu_banner.bans] == [
+        (b.ip, b.decision, b.domain) for b in tpu_banner.bans
+    ]
+    assert cpu_banner.regex_ban_logs == tpu_banner.regex_ban_logs
+    assert cpu_states.format_states() == tpu_states.format_states()
+    return tpu
+
+
+def ts(offset):
+    return time.time() + offset
+
+
+class TestByteIdenticalStreams:
+    def test_mixed_stream(self):
+        lines = [
+            f"{ts(0):f} 1.2.3.4 GET example.com GET /page HTTP/1.1 UA -",
+            f"{ts(0.1):f} 1.2.3.4 GET example.com GET /page2 HTTP/1.1 UA -",
+            f"{ts(0.2):f} 1.2.3.4 GET example.com GET /page3 HTTP/1.1 UA -",  # exceeds rule1
+            f"{ts(0.3):f} 5.6.7.8 POST example.com POST /form HTTP/1.1 UA -",
+            f"{ts(0.4):f} 5.6.7.8 POST example.com POST /form HTTP/1.1 UA -",  # exceeds rule2
+            f"{ts(0.5):f} 12.12.12.12 GET example.com GET /x HTTP/1.1 UA -",  # allowlisted
+            "not enough words",
+            f"{ts(-100):f} 9.9.9.9 GET example.com GET /old HTTP/1.1 UA -",  # stale
+            "badts 1.1.1.1 GET example.com GET /x HTTP/1.1 UA -",
+            f"{ts(0.6):f} 2.2.2.2 GET per-site.com GET /blockme HTTP/1.1 UA -",  # per-site instant
+            f"{ts(0.7):f} 3.3.3.3 DELETE skipme.com DELETE /x HTTP/1.1 UA -",  # hosts_to_skip
+            f"{ts(0.8):f} 3.3.3.3 DELETE other.com DELETE /x HTTP/1.1 UA -",  # instant iptables
+        ]
+        assert_identical_consumption(lines)
+
+    def test_window_restart_semantics(self):
+        base = time.time()
+        mk = lambda off, ip="1.2.3.4": (
+            f"{base + off:f} {ip} GET example.com GET /p HTTP/1.1 UA -"
+        )
+        lines = [mk(0), mk(4), mk(5.5), mk(6), mk(6.1), mk(6.2), mk(6.3)]
+        assert_identical_consumption(lines)
+
+    def test_nan_inf_timestamps_are_per_line_errors(self):
+        # int(nan * 1e9) raises; must mark only that line, not drop the batch
+        lines = [
+            "nan 1.2.3.4 GET example.com GET /x HTTP/1.1 UA -",
+            "inf 1.2.3.4 GET example.com GET /x HTTP/1.1 UA -",
+            f"{ts(0):f} 1.2.3.4 GET example.com GET /ok HTTP/1.1 UA -",
+        ]
+        assert_identical_consumption(lines)
+
+    def test_control_whitespace_matches_python_re(self):
+        # \x1c-\x1f are \s in Python re and must be in the device class too
+        yaml_text = r"""
+regexes_with_rates:
+  - decision: challenge
+    rule: 'ws'
+    regex: 'a\sb'
+    interval: 5
+    hits_per_interval: 0
+"""
+        lines = [
+            f"{ts(0):f} 1.2.3.4 GET example.com GET /a\x1cb HTTP/1.1 UA -",
+            f"{ts(0.1):f} 1.2.3.4 GET example.com GET /axb HTTP/1.1 UA -",
+        ]
+        assert_identical_consumption(lines, yaml_text)
+
+    def test_non_ascii_line_falls_back_to_host(self):
+        lines = [
+            f"{ts(0):f} 1.2.3.4 GET example.com GET /péage HTTP/1.1 UA -",
+            f"{ts(0.1):f} 1.2.3.4 GET example.com GET /ok HTTP/1.1 UA -",
+        ]
+        assert_identical_consumption(lines)
+
+    def test_overlong_line_falls_back_to_host(self):
+        long_path = "/x" * 400
+        lines = [f"{ts(0):f} 1.2.3.4 GET example.com GET {long_path} HTTP/1.1 UA -"]
+        tpu = assert_identical_consumption(lines)
+        assert len(lines[0].split(" ", 2)[2]) > tpu.config.matcher_max_line_len
+
+    def test_unsupported_rule_falls_back_to_host(self):
+        yaml_text = r"""
+per_site_regexes_with_rates:
+  unsupported.com:
+    - decision: challenge
+      hits_per_interval: 0
+      interval: 1
+      regex: '(GET /a)+x'
+      rule: "group-repeat"
+"""
+        lines = [
+            f"{ts(0):f} 1.2.3.4 GET unsupported.com GET /aGET /ax HTTP/1.1 UA -",
+            f"{ts(0.1):f} 1.2.3.4 GET unsupported.com GET /b HTTP/1.1 UA -",
+        ]
+        tpu = assert_identical_consumption(lines, yaml_text)
+        assert len(tpu._host_rule_idx) == 1
+
+
+class TestGenerativeStress:
+    """Scaled-down port of TestPerSiteRegexStress: every generated line must
+    trip exactly its own generated rule, on both matchers identically."""
+
+    def test_per_site_stress(self):
+        rng = random.Random(42)
+        n_rules = 200
+        sites = []
+        rule_yaml = ["per_site_regexes_with_rates:"]
+        for i in range(n_rules):
+            site = f"site-{i}.com"
+            token = "".join(rng.choice("abcdefghij") for _ in range(8))
+            sites.append((site, token))
+            rule_yaml.append(f"  {site}:")
+            rule_yaml.append("    - decision: nginx_block")
+            rule_yaml.append("      hits_per_interval: 0")
+            rule_yaml.append("      interval: 1")
+            rule_yaml.append(f"      regex: 'GET /{token}'")
+            rule_yaml.append(f"      rule: 'rule-{i}'")
+        yaml_text = "\n".join(rule_yaml)
+
+        base = time.time()
+        lines = []
+        for i, (site, token) in enumerate(sites):
+            lines.append(
+                f"{base + i * 0.001:f} 10.0.{i // 256}.{i % 256} "
+                f"GET {site} GET /{token} HTTP/1.1 UA -"
+            )
+        rng.shuffle(lines)
+
+        (cpu, _, cpu_banner), (tpu, _, tpu_banner) = make_pair(yaml_text)
+        now = time.time()
+        cpu_results = [cpu.consume_line(l, now_unix=now) for l in lines]
+        tpu_results = tpu.consume_lines(lines, now_unix=now)
+        for a, b in zip(cpu_results, tpu_results):
+            assert result_key(a) == result_key(b)
+        # every line tripped exactly one rule
+        assert all(len(r.rule_results) == 1 for r in tpu_results)
+        assert cpu_banner.regex_ban_logs == tpu_banner.regex_ban_logs
+        assert len(tpu_banner.bans) == n_rules

@@ -1,0 +1,68 @@
+"""Multi-device sharded matcher vs single-device reference (8-dev CPU mesh).
+
+conftest.py forces xla_force_host_platform_device_count=8, the same
+mechanism the driver uses to validate multi-chip sharding without hardware.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from banjax_tpu.matcher import nfa_jax
+from banjax_tpu.matcher.encode import encode_for_match
+from banjax_tpu.matcher.rulec import compile_rules
+from banjax_tpu.parallel.mesh import make_mesh, shard_params, sharded_match_fn
+
+PATTERNS = [
+    r"GET /wp-login\.php",
+    r"POST /xmlrpc\.php",
+    r"(GET|POST) /[a-z-]*\.php",
+    r"^GET .* HTTP/1\.1$",
+    r"Mozilla/\d+\.\d+",
+    r"a+b",
+    r"[0-9]{2,4}",
+    r".*",
+    r"^$",
+    r"wp-admin",
+]
+
+LINES = [
+    "GET example.com GET /wp-login.php HTTP/1.1",
+    "POST example.com POST /xmlrpc.php HTTP/1.1",
+    "GET example.com GET / HTTP/1.1",
+    "aaab and 123",
+    "Mozilla/5.0 something",
+    "",
+    "nothing interesting here",
+    "GET site.org GET /wp-admin/panel HTTP/1.1",
+] * 4  # 32 lines, divisible by dp
+
+
+@pytest.mark.parametrize("dp,rp", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_sharded_matches_single_device(dp, rp):
+    if len(jax.devices()) < dp * rp:
+        pytest.skip("needs 8 virtual devices")
+    compiled = compile_rules(PATTERNS, n_shards=rp)
+    mesh = make_mesh(dp * rp, rp=rp)
+    fn = sharded_match_fn(compiled, mesh)
+    params = shard_params(compiled, mesh)
+    cls_ids, lens, host_eval = encode_for_match(compiled, LINES, 128)
+    assert not host_eval.any()
+    got = np.asarray(fn(params, cls_ids, lens))
+
+    ref_compiled = compile_rules(PATTERNS, n_shards=1)
+    ref = np.asarray(
+        nfa_jax.match_batch(
+            nfa_jax.match_params(ref_compiled),
+            *encode_for_match(ref_compiled, LINES, 128)[:2],
+            ref_compiled.n_rules,
+        )
+    )
+    assert (got == ref).all()
+    # and both equal the re oracle
+    for j, pat in enumerate(PATTERNS):
+        rx = re.compile(pat)
+        for i, line in enumerate(LINES):
+            assert bool(got[i, j]) == (rx.search(line) is not None)

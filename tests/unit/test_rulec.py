@@ -1,0 +1,254 @@
+"""Differential tests: rule compiler + device NFA vs Python re (the oracle).
+
+The acceptance bar for the TPU matcher is byte-identical match decisions
+against the CpuMatcher path, which uses Python `re` (itself mirroring the
+Go regexp behavior of /root/reference/internal/regex_rate_limiter.go:234).
+These tests compile pattern sets with rulec, run the jitted shift-and scan
+on the 8-virtual-device CPU backend, and assert the match bitmap equals
+re.search on every (pattern, line) pair — the generalization of the
+reference's generative stress test
+(/root/reference/internal/regex_rate_limiter_test.go:298-360).
+"""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from banjax_tpu.matcher import nfa_jax
+from banjax_tpu.matcher.encode import encode_for_match
+from banjax_tpu.matcher.rulec import (
+    UnsupportedPattern,
+    compile_rule,
+    compile_rules,
+)
+
+
+def run_device_match(patterns, lines, n_shards=1, max_len=128):
+    compiled = compile_rules(patterns, n_shards=n_shards)
+    params = nfa_jax.match_params(compiled)
+    cls_ids, lens, host_eval = encode_for_match(compiled, lines, max_len)
+    assert not host_eval.any(), "test lines must be device-evaluable"
+    out = nfa_jax.match_batch(params, cls_ids, lens, compiled.n_rules)
+    return np.asarray(out), compiled
+
+
+def assert_matches_re(patterns, lines, n_shards=1):
+    matched, compiled = run_device_match(patterns, lines, n_shards=n_shards)
+    # every pattern given to this helper must actually compile for the
+    # device — a silent host fallback would make the comparison vacuous
+    fell_back = [patterns[i] for i in compiled.unsupported]
+    assert not fell_back, f"unexpected host fallback: {fell_back}"
+    for j, pat in enumerate(patterns):
+        rx = re.compile(pat)
+        for i, line in enumerate(lines):
+            expected = rx.search(line) is not None
+            got = bool(matched[i, j])
+            assert got == expected, (
+                f"pattern {pat!r} line {line!r}: device={got} re={expected}"
+            )
+    return compiled
+
+
+LINES = [
+    "",
+    "a",
+    "b",
+    "ab",
+    "ba",
+    "abc",
+    "aab",
+    "abab",
+    "hello world",
+    "GET /wp-login.php HTTP/1.1",
+    "POST /xmlrpc.php HTTP/1.1",
+    "GET / HTTP/1.1",
+    "aaaa",
+    "xyzzy",
+    "0123456789",
+    "a-b_c.d",
+    "foo  bar",
+    "PUT /a/b/c?x=1&y=2",
+    "Mozilla/5.0 (X11; Linux x86_64)",
+    "....",
+    "aXbXc",
+    "tab\there",
+    "trailing space ",
+    " leading",
+    "abba",
+    "aa",
+    "A",
+    "AB",
+    "Hello World",
+]
+
+
+class TestBasicConstructs:
+    def test_literal(self):
+        assert_matches_re(["abc", "a", "z"], LINES)
+
+    def test_dot(self):
+        assert_matches_re(["a.c", "...", "^.$"], LINES)
+
+    def test_classes(self):
+        assert_matches_re(
+            [r"[ab]c", r"[^a]b", r"[a-z]+", r"[0-9]{3}", r"[\d]", r"[a-cx-z]"],
+            LINES,
+        )
+
+    def test_escapes(self):
+        assert_matches_re([r"\d+", r"\w+", r"\s", r"\.", r"a\-b", r"\S+"], LINES)
+
+    def test_anchors(self):
+        assert_matches_re(
+            [r"^a", r"a$", r"^ab$", r"^$", r"^", r"$", r"\Aab", r"ab\Z"], LINES
+        )
+
+    def test_alternation(self):
+        assert_matches_re([r"a|b", r"ab|ba", r"^(a|b)b$", r"x|", r"(GET|POST) /"], LINES)
+
+    def test_quantifiers(self):
+        assert_matches_re(
+            [r"a*b", r"a+b", r"a?b", r"a{2}", r"a{2,}", r"a{1,3}b", r"ba{0,2}"],
+            LINES,
+        )
+
+    def test_star_of_class(self):
+        assert_matches_re([r"[ab]*c", r"a[^b]*b", r".*", r".+", r"x.*y"], LINES)
+
+    def test_groups(self):
+        assert_matches_re(
+            [r"(ab){2}", r"(a|b){2,3}", r"(?:ab)?c", r"((a)(b))", r"(ab){1,3}"],
+            LINES,
+        )
+
+    def test_nested_quantified_groups(self):
+        assert_matches_re([r"(a+)", r"(a*)b", r"(a?){2}b", r"(a|b+){2}"], LINES)
+
+    def test_case_insensitive(self):
+        assert_matches_re([r"(?i)hello", r"(?i)a", r"(?i:ab)", r"(?i)[a-z]+"], LINES)
+
+    def test_lazy_quantifiers_same_language(self):
+        assert_matches_re([r"a*?b", r"a+?", r"a??b", r"a{1,2}?b"], LINES)
+
+    def test_realistic_rules(self):
+        assert_matches_re(
+            [
+                r"GET /wp-login\.php",
+                r"POST /xmlrpc\.php",
+                r"(GET|POST) /[a-z-]*\.php",
+                r"^GET .* HTTP/1\.1$",
+                r"Mozilla/\d+\.\d+",
+                r"HTTP/1\.[01]$",
+            ],
+            LINES,
+        )
+
+
+class TestDegenerateAndUnsupported:
+    def test_always_match_short_circuit(self):
+        compiled = compile_rules([r".*", r"a"])
+        assert compiled.always_match[0]
+        assert not compiled.always_match[1]
+        # degenerate rules contribute no branches (SURVEY §7.3 hard part 1)
+        assert all(r != 0 for r in compiled.branch_rule)
+
+    def test_empty_only(self):
+        matched, compiled = run_device_match([r"^$"], ["", "a"])
+        assert compiled.empty_only[0]
+        assert matched[0, 0] == 1 and matched[1, 0] == 0
+
+    @pytest.mark.parametrize(
+        "pattern",
+        [r"(ab)*", r"(ab)+x", r"\bword\b", r"(?m)^a", r"a{40}{40}", r"(abc|def){100}"],
+    )
+    def test_unsupported_fall_back(self, pattern):
+        with pytest.raises(UnsupportedPattern):
+            compile_rule(pattern)
+
+    def test_unsupported_marked_not_fatal(self):
+        compiled = compile_rules([r"a", r"(ab)+", r"b"])
+        assert list(compiled.device_ok) == [True, False, True]
+        assert 1 in compiled.unsupported
+
+    def test_dead_branch_dropped(self):
+        matched, _ = run_device_match([r"a^b", r"a$b"], ["ab", "a^b"])
+        assert matched.sum() == 0
+
+
+class TestSharding:
+    def test_sharded_layout_matches_unsharded(self):
+        patterns = [r"a+b", r"^GET /", r"[0-9]{2,4}", r"x|yz", r"wp-login"]
+        m1, _ = run_device_match(patterns, LINES, n_shards=1)
+        m4, c4 = run_device_match(patterns, LINES, n_shards=4)
+        assert (m1 == m4).all()
+        assert c4.n_shards == 4
+
+    def test_no_branch_straddles_shard_boundary(self):
+        patterns = [r"abcdefgh" * 8, r"a{30}", r"[a-z]{33}"]
+        c = compile_rules(patterns, n_shards=2)
+        span = c.words_per_shard * 32
+        # accept bit and its branch start must be in the same shard
+        starts = {}
+        for k in range(len(c.acc_word)):
+            end_bit = int(c.acc_word[k]) * 32 + int(c.acc_mask[k]).bit_length() - 1
+            starts[k] = end_bit
+        for k, end_bit in starts.items():
+            assert end_bit < c.n_shards * span
+
+
+class TestFuzzDifferential:
+    """Generative differential test à la the reference's TestPerSiteRegexStress."""
+
+    def test_random_patterns_vs_re(self):
+        rng = random.Random(20260729)
+        alphabet = "abxy01 /."
+
+        def gen_atom(depth):
+            r = rng.random()
+            if r < 0.35:
+                return re.escape(rng.choice(alphabet))
+            if r < 0.5:
+                return rng.choice([r"\d", r"\w", r"[ab]", r"[^x]", "."])
+            if r < 0.6 and depth < 2:
+                return "(" + gen_pattern(depth + 1) + ")"
+            return re.escape(rng.choice(alphabet))
+
+        def gen_piece(depth):
+            atom = gen_atom(depth)
+            r = rng.random()
+            if r < 0.2:
+                return atom + rng.choice(["*", "+", "?"])
+            if r < 0.25:
+                return atom + "{%d,%d}" % (rng.randint(0, 2), rng.randint(2, 4))
+            return atom
+
+        def gen_pattern(depth=0):
+            seq = "".join(gen_piece(depth) for _ in range(rng.randint(1, 5)))
+            if rng.random() < 0.2:
+                seq = seq + "|" + "".join(gen_piece(depth) for _ in range(rng.randint(1, 3)))
+            return seq
+
+        patterns = []
+        while len(patterns) < 60:
+            p = gen_pattern()
+            if rng.random() < 0.15:
+                p = "^" + p
+            if rng.random() < 0.15:
+                p = p + "$"
+            try:
+                re.compile(p)
+                compile_rule(p)
+            except UnsupportedPattern:
+                continue
+            except re.error:
+                continue
+            patterns.append(p)
+
+        lines = [
+            "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 24)))
+            for _ in range(120)
+        ]
+        assert_matches_re(patterns, lines, n_shards=1)
+        assert_matches_re(patterns, lines, n_shards=4)
