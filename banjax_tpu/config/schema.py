@@ -143,6 +143,10 @@ class Config:
     matcher: str = "cpu"  # "cpu" | "tpu" — the Matcher seam flag (BASELINE.json)
     matcher_batch_lines: int = 16384
     matcher_max_line_len: int = 256
+    # device backend for the TPU matcher: "auto" picks the Pallas kernel on
+    # TPU and the XLA scan elsewhere; "pallas-interpret" runs the kernel as
+    # plain JAX for CI (SURVEY.md §4 carry-over (f))
+    matcher_backend: str = "auto"  # "auto" | "xla" | "pallas" | "pallas-interpret"
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -171,6 +175,7 @@ _SCALAR_KEYS = {
     "session_cookie_hmac_secret": str, "session_cookie_ttl_seconds": int,
     "session_cookie_not_verify": bool, "dnet": str, "standalone_testing": bool,
     "matcher": str, "matcher_batch_lines": int, "matcher_max_line_len": int,
+    "matcher_backend": str,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -232,6 +237,14 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
         cfg.per_site_regexes_with_rates[site] = [
             RegexWithRate.from_yaml_dict(e) for e in (entries or [])
         ]
+
+    if cfg.matcher not in ("cpu", "tpu"):
+        raise ValueError(f"config key matcher: expected cpu|tpu, got {cfg.matcher!r}")
+    if cfg.matcher_backend not in ("auto", "xla", "pallas", "pallas-interpret"):
+        raise ValueError(
+            "config key matcher_backend: expected "
+            f"auto|xla|pallas|pallas-interpret, got {cfg.matcher_backend!r}"
+        )
 
     return cfg
 

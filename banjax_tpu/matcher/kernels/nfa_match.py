@@ -1,0 +1,334 @@
+"""Pallas TPU kernel: batched bit-parallel NFA match.
+
+This is the hand-scheduled version of banjax_tpu/matcher/nfa_jax.py — the
+device replacement for the reference's serial per-(line, rule) regexp loop
+(/root/reference/internal/regex_rate_limiter.go:216-269). The XLA scan in
+nfa_jax is correct but its per-byte `jnp.take(b_table, cls)` gather is at
+the mercy of XLA's gather lowering; this kernel instead
+
+  * keeps the NFA state and the whole transition table resident in VMEM
+    across the full byte scan — per block, HBM traffic is one read of the
+    encoded lines and one write of the accept words, nothing else;
+  * performs the byte-class → transition-mask gather as a one-hot matmul on
+    the MXU: the uint32 table is split into four 8-bit planes stored as
+    bf16, and `table[4W, C] @ onehot[C, block]` is exact because every
+    one-hot column selects a single integer ≤ 255 (bf16 represents
+    integers up to 256 exactly — 16-bit halves would NOT survive the
+    MXU's single-pass bf16 mode). The gather rides the systolic array at
+    full single-pass speed;
+  * advances all rules at once with uint32 shift-and ops on the VPU.
+
+Layout is TRANSPOSED versus nfa_jax: state is [W, block] — NFA words on
+sublanes, lines on lanes. That makes the cross-word carry a sublane roll,
+lets every mask slice be tiling-aligned (wps_p is a lane multiple), and
+gives the per-byte column DMA a [8, block] tile. The byte position is the
+innermost (sequential) grid axis: the Pallas pipeline double-buffers each
+byte-row tile while the previous one computes; NFA state lives in VMEM
+scratch across grid steps (reset at byte 0), accept bits accumulate into
+the revisited output block.
+
+Sharding: rule shards (rulec guarantees no branch straddles a shard
+boundary) map to a grid axis — each (line-block, shard) pair scans an
+independent word slab, so the same kernel serves the single-chip path and
+the per-device body of the rp-sharded mesh path.
+
+The `interpret=True` mode runs the identical kernel as plain JAX on CPU —
+the CI path (SURVEY.md §4 carry-over (f)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from banjax_tpu.matcher.rulec import CompiledRules
+
+# mask-column indices in the packed [W, 8] uint32 mask tensor
+_SHIFT_IN, _INJ_ALWAYS, _INJ_START, _SELFLOOP, _ACC_ANY, _ACC_END = range(6)
+
+_LANE = 128            # TPU lane width
+_SUBLANE = 8           # int32/f32 sublane tile
+_COLS_PER_STEP = 8     # byte columns processed per grid step (one sublane tile)
+_DEFAULT_BLOCK_B = 256
+_MAX_WORDS_PER_SHARD = 2048  # VMEM guard: beyond this, fall back to nfa_jax
+
+
+class PallasUnsupported(ValueError):
+    """Ruleset shape the kernel refuses (caller falls back to nfa_jax)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PallasRules:
+    """Kernel-ready repack of CompiledRules (padded, shard-major, transposed)."""
+
+    n_rules: int
+    n_shards: int
+    wps: int             # original words per shard
+    wps_p: int           # padded to a lane multiple
+    n_classes_p: int     # padded to a lane multiple (it's the dot's lane axis)
+    btab_t: jnp.ndarray  # [n_shards * 4 * wps_p, C_p] bf16 — 4 byte planes per shard
+    masks_t: jnp.ndarray  # [n_shards * wps_p, 8] uint32
+    # extraction arrays (word indices remapped into the padded word space)
+    acc_word: jnp.ndarray     # [n_branches] int32
+    acc_mask: jnp.ndarray     # [n_branches] uint32
+    branch_rule: jnp.ndarray  # [n_branches] int32
+    always_match: jnp.ndarray  # [n_rules] bool
+    empty_only: jnp.ndarray    # [n_rules] bool
+    # jitted device_matcher per (B, L_p, block_b, interpret) — a mutable
+    # cache inside a frozen dataclass, keyed per ruleset by construction
+    _fns: dict = dataclasses.field(default_factory=dict, compare=False, repr=False)
+
+    @property
+    def total_words(self) -> int:
+        return self.n_shards * self.wps_p
+
+    def jitted(self, B: int, L_p: int, block_b: int, interpret: bool,
+               pack: bool = False):
+        key = (B, L_p, block_b, interpret, pack)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = jax.jit(device_matcher(self, B, L_p, block_b, interpret, pack))
+            self._fns[key] = fn
+        return fn
+
+
+def _pad_to(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+def auto_shards(n_words: int, target_wps: int = 384) -> int:
+    """Shard count that keeps each shard's word slab in VMEM comfortably.
+
+    384 words (≈12k NFA positions) pads to a 512-word slab: the per-step
+    transient `planes[4W, block]` stays ≈2 MB and the per-shard tables
+    ≈1 MB, leaving headroom for double-buffered byte tiles at block=256.
+    """
+    return max(1, -(-n_words // target_wps))
+
+
+def prepare(compiled: CompiledRules) -> PallasRules:
+    """Repack a compiled ruleset for the kernel.
+
+    Each shard's `wps` words are padded independently to a lane multiple so
+    a grid step over shard j addresses a self-contained, aligned word slab;
+    accept-word indices are remapped to match. Padding words carry all-zero
+    masks, so any state bit shifted into them is annihilated by `& bmask`.
+    """
+    ns, wps = compiled.n_shards, compiled.words_per_shard
+    wps_p = max(_LANE, _pad_to(wps, _LANE))
+    if wps_p > _MAX_WORDS_PER_SHARD:
+        raise PallasUnsupported(
+            f"{wps_p} words/shard exceeds the VMEM budget "
+            f"({_MAX_WORDS_PER_SHARD}); use more rule shards or nfa_jax"
+        )
+    C = compiled.n_classes
+    C_p = max(_LANE, _pad_to(C, _LANE))
+
+    btab_t = np.zeros((ns * 4 * wps_p, C_p), dtype=np.float32)
+    masks_t = np.zeros((ns * wps_p, 8), dtype=np.uint32)
+    b = compiled.b_table  # [C, ns * wps] uint32
+    mask_rows = [
+        compiled.shift_in, compiled.inject_always, compiled.inject_start,
+        compiled.selfloop, compiled.accept_any, compiled.accept_end,
+    ]
+    for j in range(ns):
+        sl = slice(j * wps, (j + 1) * wps)
+        for plane in range(4):
+            vals = ((b[:, sl] >> np.uint32(8 * plane)) & np.uint32(0xFF)).astype(
+                np.float32
+            )  # [C, wps]
+            base = j * 4 * wps_p + plane * wps_p
+            btab_t[base : base + wps, :C] = vals.T
+        for r, row in enumerate(mask_rows):
+            masks_t[j * wps_p : j * wps_p + wps, r] = row[sl]
+
+    shard_of = compiled.acc_word // wps if compiled.acc_word.size else compiled.acc_word
+    acc_word_p = (shard_of * wps_p + compiled.acc_word % wps).astype(np.int32)
+
+    return PallasRules(
+        n_rules=compiled.n_rules,
+        n_shards=ns,
+        wps=wps,
+        wps_p=wps_p,
+        n_classes_p=C_p,
+        btab_t=jnp.asarray(btab_t, dtype=jnp.bfloat16),
+        masks_t=jnp.asarray(masks_t),
+        acc_word=jnp.asarray(acc_word_p),
+        acc_mask=jnp.asarray(compiled.acc_mask),
+        branch_rule=jnp.asarray(compiled.branch_rule),
+        always_match=jnp.asarray(compiled.always_match),
+        empty_only=jnp.asarray(compiled.empty_only),
+    )
+
+
+def _kernel(cls_rows_ref, lens_ref, btab_ref, masks_ref, out_ref, d_ref,
+            *, C, W, use_roll):
+    """One (line-block, rule-shard, byte-tile) grid step: 8 byte columns."""
+    t = pl.program_id(2)
+    bB = cls_rows_ref.shape[1]
+    shift_in = masks_ref[:, _SHIFT_IN : _SHIFT_IN + 1]      # [W, 1]
+    inj_always = masks_ref[:, _INJ_ALWAYS : _INJ_ALWAYS + 1]
+    inj_start = masks_ref[:, _INJ_START : _INJ_START + 1]
+    selfloop = masks_ref[:, _SELFLOOP : _SELFLOOP + 1]
+    acc_any = masks_ref[:, _ACC_ANY : _ACC_ANY + 1]
+    acc_end = masks_ref[:, _ACC_END : _ACC_END + 1]
+    zero = jnp.uint32(0)
+
+    @pl.when(t == 0)
+    def _init():
+        d_ref[:] = jnp.zeros((W, bB), dtype=jnp.uint32)
+        out_ref[:] = jnp.zeros((W, bB), dtype=jnp.uint32)
+
+    last_col = lens_ref[:] - 1  # [1, bB]
+    cls_iota = jax.lax.broadcasted_iota(jnp.int32, (C, bB), 0)
+    d = d_ref[:]
+    acc = out_ref[:]
+    for k in range(_COLS_PER_STEP):
+        cls_row = cls_rows_ref[k : k + 1, :]                  # [1, bB]
+        onehot = (cls_row == cls_iota).astype(jnp.bfloat16)   # [C, bB]
+        # MXU gather: one-hot columns select byte values ≤ 255, exact in bf16
+        planes = jnp.dot(btab_ref[:], onehot, preferred_element_type=jnp.float32)
+        # Mosaic has no f32→u32 cast; values ≤ 255 so f32→i32→u32 is exact
+        pi = planes.astype(jnp.int32).astype(jnp.uint32)      # [4W, bB]
+        bmask = (
+            pi[:W]
+            | (pi[W : 2 * W] << 8)
+            | (pi[2 * W : 3 * W] << 16)
+            | (pi[3 * W :] << 24)
+        )
+        c31 = d >> 31
+        if use_roll:
+            sub0 = jax.lax.broadcasted_iota(jnp.int32, (W, bB), 0) == 0
+            carry_bits = pltpu.roll(c31, shift=1, axis=0)
+            carry_bits = jnp.where(sub0, zero, carry_bits)
+        else:  # interpret mode: plain-JAX equivalent of the sublane roll
+            carry_bits = jnp.concatenate(
+                [jnp.zeros((1, bB), jnp.uint32), c31[:-1, :]], axis=0
+            )
+        shifted = ((d << 1) | carry_bits) & shift_in
+        if k == 0:
+            inject = jnp.where(t == 0, inj_always | inj_start, inj_always)
+        else:
+            inject = inj_always
+        d = ((shifted | inject) & bmask) | (d & bmask & selfloop)
+        acc = acc | (d & acc_any)
+        l = t * _COLS_PER_STEP + k
+        acc = acc | jnp.where(last_col == l, d & acc_end, zero)
+    d_ref[:] = d
+    out_ref[:] = acc
+
+
+def device_matcher(prep: PallasRules, B: int, L_p: int,
+                   block_b: int = _DEFAULT_BLOCK_B, interpret: bool = False,
+                   pack: bool = False):
+    """Build the traceable device step: fn(cls_t [L_p, B], lens [B]) →
+    matched [B, n_rules] uint8 (or [B, ceil(n_rules/8)] bit-packed when
+    `pack` — 8× less device→host traffic for the runner's bitmap pull).
+    Composable inside an outer jit (the bench harness chains it; the
+    runner jits it standalone)."""
+    call = _build_raw_call(
+        B, L_p, prep.n_classes_p, prep.n_shards, prep.wps_p, block_b, interpret
+    )
+    acc_word, acc_mask = prep.acc_word, prep.acc_mask
+    branch_rule = prep.branch_rule
+    always_match, empty_only = prep.always_match, prep.empty_only
+    n_rules = prep.n_rules
+    btab_t, masks_t = prep.btab_t, prep.masks_t
+
+    def fn(cls_t, lens):
+        acc_t = call(cls_t, lens[None, :], btab_t, masks_t)  # [ns*wps_p, B]
+        acc = acc_t.T
+        matched = jnp.zeros((B, n_rules), dtype=jnp.uint8)
+        if acc_word.shape[0] > 0:
+            sel = (acc[:, acc_word] & acc_mask) != 0
+            matched = matched.at[:, branch_rule].max(sel.astype(jnp.uint8))
+        matched = matched | always_match.astype(jnp.uint8)[None, :]
+        empty = (lens == 0)[:, None]
+        matched = matched | (
+            empty_only.astype(jnp.uint8)[None, :] & empty.astype(jnp.uint8)
+        )
+        if pack:
+            return jnp.packbits(matched.astype(jnp.bool_), axis=1)
+        return matched
+
+    return fn
+
+
+@functools.lru_cache(maxsize=32)
+def _build_raw_call(
+    B: int, L_p: int, C: int, ns: int, wps_p: int, block_b: int, interpret: bool
+):
+    if B % block_b or L_p % _COLS_PER_STEP:
+        # a floor-divided grid would silently skip the tail of the batch
+        raise PallasUnsupported(
+            f"B={B} must be a multiple of block_b={block_b} and "
+            f"L_p={L_p} a multiple of {_COLS_PER_STEP} (pad first, "
+            "as match_batch_pallas does)"
+        )
+    grid = (B // block_b, ns, L_p // _COLS_PER_STEP)
+    kern = functools.partial(_kernel, C=C, W=wps_p, use_roll=not interpret)
+    call = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            # cls transposed [L_p, B]: one sublane tile of byte rows per step
+            pl.BlockSpec(
+                (_COLS_PER_STEP, block_b), lambda i, j, t: (t, i),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, block_b), lambda i, j, t: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (4 * wps_p, C), lambda i, j, t: (j, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((wps_p, 8), lambda i, j, t: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (wps_p, block_b), lambda i, j, t: (j, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((ns * wps_p, B), jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((wps_p, block_b), jnp.uint32)],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * L_p * C * 4 * wps_p * ns,
+            bytes_accessed=B * L_p * 4 + B * ns * wps_p * 4,
+            transcendentals=0,
+        ),
+    )
+    return call
+
+
+def match_batch_pallas(
+    prep: PallasRules,
+    cls_ids,
+    lens,
+    *,
+    block_b: int = _DEFAULT_BLOCK_B,
+    interpret: bool = False,
+    packed: bool = False,
+) -> np.ndarray:
+    """[B, L] encoded lines → [B, n_rules] uint8 match bits via the kernel
+    (bit-packed along the rule axis when `packed`).
+
+    Pads the batch up to a block multiple; semantics identical to
+    nfa_jax.match_batch (differentially tested in tests/unit/test_nfa_pallas.py).
+    """
+    if not interpret and block_b % _LANE:
+        raise PallasUnsupported(f"block_b {block_b} must be a multiple of {_LANE}")
+    cls_ids = np.asarray(cls_ids, dtype=np.int32)
+    lens = np.asarray(lens, dtype=np.int32)
+    B, L = cls_ids.shape
+    Bp = max(block_b, _pad_to(B, block_b))
+    L_p = max(_COLS_PER_STEP, _pad_to(L, _COLS_PER_STEP))
+    cls_t = np.zeros((L_p, Bp), dtype=np.int32)
+    cls_t[:L, :B] = cls_ids.T
+    if Bp != B:
+        lens = np.pad(lens, (0, Bp - B))
+    run = prep.jitted(Bp, L_p, block_b, interpret, packed)
+    out = run(jnp.asarray(cls_t), jnp.asarray(lens))
+    return np.asarray(out)[:B]

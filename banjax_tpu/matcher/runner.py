@@ -26,9 +26,11 @@ import logging
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from banjax_tpu.config.schema import Config, RegexWithRate
+from banjax_tpu.matcher.kernels import nfa_match as pallas_nfa
 from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
 from banjax_tpu.decisions.static_lists import StaticDecisionLists
 from banjax_tpu.effectors.banner import BannerInterface
@@ -84,6 +86,44 @@ class TpuMatcher(Matcher):
         self._params = nfa_jax.match_params(self.compiled)
         self._max_len = config.matcher_max_line_len
         self._max_batch = max(_MIN_BUCKET, config.matcher_batch_lines)
+
+        # device backend: the Pallas kernel where it pays (TPU), the XLA
+        # scan elsewhere; "pallas-interpret" is the CI path
+        backend = getattr(config, "matcher_backend", "auto") or "auto"
+        self._pallas_prep = None
+        self._pallas_interpret = backend == "pallas-interpret"
+        if backend == "pallas" and jax.default_backend() != "tpu":
+            # compiled Mosaic can't lower off-TPU; failing per-batch at
+            # runtime would drop every log line, so degrade at init instead
+            log.warning(
+                "matcher_backend=pallas requested but the JAX backend is %s; "
+                "falling back to the XLA scan", jax.default_backend(),
+            )
+            backend = "xla"
+        want_pallas = backend in ("pallas", "pallas-interpret") or (
+            backend == "auto" and jax.default_backend() == "tpu"
+        )
+        if want_pallas:
+            try:
+                comp = self.compiled
+                ns = pallas_nfa.auto_shards(comp.n_words)
+                if ns > comp.n_shards:
+                    # re-shard the ruleset so each shard's word slab fits
+                    # VMEM; byte classes are shard-independent by rulec
+                    # construction — encode uses self.compiled's table, so
+                    # check the invariant rather than trust it
+                    comp = compile_rules(
+                        [r.regex_string for _, r in self._entries], n_shards=ns
+                    )
+                    if not np.array_equal(
+                        comp.byte_to_class, self.compiled.byte_to_class
+                    ):
+                        raise pallas_nfa.PallasUnsupported(
+                            "byte-class table changed across re-shard"
+                        )
+                self._pallas_prep = pallas_nfa.prepare(comp)
+            except pallas_nfa.PallasUnsupported as e:
+                log.info("pallas matcher backend unavailable (%s); using XLA scan", e)
 
     # ---- Matcher API ----
 
@@ -154,12 +194,19 @@ class TpuMatcher(Matcher):
             pad_len = np.zeros(b, dtype=np.int32)
             pad_cls[: len(rows)] = cls_ids[rows]
             pad_len[: len(rows)] = lens[rows]
-            packed = np.asarray(
-                nfa_jax.match_batch_packed(
-                    self._params, pad_cls, pad_len, self.compiled.n_rules
+            if self._pallas_prep is not None:
+                packed = pallas_nfa.match_batch_pallas(
+                    self._pallas_prep, pad_cls, pad_len,
+                    interpret=self._pallas_interpret, packed=True,
                 )
-            )
-            out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
+                out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
+            else:
+                packed = np.asarray(
+                    nfa_jax.match_batch_packed(
+                        self._params, pad_cls, pad_len, self.compiled.n_rules
+                    )
+                )
+                out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
             bits[rows] = out[: len(rows)]
 
         # host fallback: whole lines the device can't decide
