@@ -55,6 +55,28 @@ def build_matcher(config, banner, static_lists, regex_states):
     return CpuMatcher(config, banner, static_lists, regex_states)
 
 
+class RegexStatesView:
+    """Introspection facade: when the TPU matcher runs device-resident
+    windows (matcher/windows.py), /rate_limit_states and the metrics
+    reporter must read those counters, not the bypassed host dict."""
+
+    def __init__(self, app: "BanjaxApp"):
+        self._app = app
+
+    def _target(self):
+        dw = getattr(self._app._matcher, "device_windows", None)
+        return dw if dw is not None else self._app.regex_states
+
+    def format_states(self) -> str:
+        return self._target().format_states()
+
+    def get(self, ip):
+        return self._target().get(ip)
+
+    def __len__(self) -> int:
+        return len(self._target())
+
+
 class BanjaxApp:
     """Builds all state and owns the worker lifecycle (banjax.go main)."""
 
@@ -95,7 +117,7 @@ class BanjaxApp:
             "list-metrics.log" if config.standalone_testing else config.metrics_log_file
         )
         self.metrics = MetricsReporter(
-            metrics_path, self.dynamic_lists, self.regex_states,
+            metrics_path, self.dynamic_lists, RegexStatesView(self),
             self.failed_challenge_states,
         )
 
@@ -181,7 +203,7 @@ class BanjaxApp:
             static_lists=self.static_lists,
             dynamic_lists=self.dynamic_lists,
             protected_paths=self.protected_paths,
-            regex_states=self.regex_states,
+            regex_states=RegexStatesView(self),
             failed_challenge_states=self.failed_challenge_states,
             banner=self.banner,
             gin_log_file=self._gin_log_file,

@@ -147,6 +147,12 @@ class Config:
     # TPU and the XLA scan elsewhere; "pallas-interpret" runs the kernel as
     # plain JAX for CI (SURVEY.md §4 carry-over (f))
     matcher_backend: str = "auto"  # "auto" | "xla" | "pallas" | "pallas-interpret"
+    # device-resident fixed-window counters (matcher/windows.py): the batch
+    # of match events folds into persistent [capacity, n_rules] arrays on
+    # the TPU instead of the host dict. Counters reset on config reload
+    # (rule ids reindex); the reference keeps them (keyed by rule name).
+    matcher_device_windows: bool = False
+    matcher_window_capacity: int = 16384  # IP slots (LRU-evicted)
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -175,7 +181,8 @@ _SCALAR_KEYS = {
     "session_cookie_hmac_secret": str, "session_cookie_ttl_seconds": int,
     "session_cookie_not_verify": bool, "dnet": str, "standalone_testing": bool,
     "matcher": str, "matcher_batch_lines": int, "matcher_max_line_len": int,
-    "matcher_backend": str,
+    "matcher_backend": str, "matcher_device_windows": bool,
+    "matcher_window_capacity": int,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -244,6 +251,11 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
         raise ValueError(
             "config key matcher_backend: expected "
             f"auto|xla|pallas|pallas-interpret, got {cfg.matcher_backend!r}"
+        )
+    if cfg.matcher_window_capacity <= 0:
+        raise ValueError(
+            "config key matcher_window_capacity: expected a positive slot "
+            f"count, got {cfg.matcher_window_capacity}"
         )
 
     return cfg

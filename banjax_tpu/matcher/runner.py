@@ -27,11 +27,15 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from banjax_tpu.config.schema import Config, RegexWithRate
 from banjax_tpu.matcher.kernels import nfa_match as pallas_nfa
-from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.rate_limit import (
+    RateLimitResult,
+    RegexRateLimitStates,
+)
 from banjax_tpu.decisions.static_lists import StaticDecisionLists
 from banjax_tpu.effectors.banner import BannerInterface
 from banjax_tpu.matcher import nfa_jax
@@ -103,6 +107,40 @@ class TpuMatcher(Matcher):
         want_pallas = backend in ("pallas", "pallas-interpret") or (
             backend == "auto" and jax.default_backend() == "tpu"
         )
+        # device-resident window counters (matcher/windows.py): authoritative
+        # for the regex rules when enabled; the host RegexRateLimitStates is
+        # bypassed (introspection goes through self.device_windows)
+        self.device_windows = None
+        self._active_table = None
+        self._host_row: Dict[str, int] = {}
+        if getattr(config, "matcher_device_windows", False):
+            from banjax_tpu.matcher.windows import DeviceWindows
+
+            self.device_windows = DeviceWindows(
+                [r for _, r in self._entries],
+                capacity=getattr(config, "matcher_window_capacity", 16384),
+            )
+            # active_table[h, rid]: rule rid applies to lines of host row h
+            # (per-site rules of that host + global rules), minus
+            # hosts_to_skip — the per-site-then-global loop of
+            # regex_rate_limiter.go:175-211 as a device mask
+            hosts = sorted(
+                set(self._per_site_idx)
+                | {h for _, r in self._entries for h in r.hosts_to_skip}
+            )
+            self._host_row = {h: i + 1 for i, h in enumerate(hosts)}
+            n_rules = len(self._entries)
+            table = np.zeros((len(hosts) + 1, max(1, n_rules)), dtype=bool)
+            for row_host, row in [(None, 0)] + list(self._host_row.items()):
+                ids = (
+                    self._per_site_idx.get(row_host, []) if row_host else []
+                ) + self._global_idx
+                for idx in ids:
+                    if row_host and self._entries[idx][1].hosts_to_skip.get(row_host):
+                        continue
+                    table[row, idx] = True
+            self._active_table = jnp.asarray(table)
+
         if want_pallas:
             try:
                 comp = self.compiled
@@ -157,8 +195,15 @@ class TpuMatcher(Matcher):
         # 2. device match bitmap for all matchable lines
         bits = self._match_bits([p for _, p in work])
 
-        # 3. host window pass in original line order: per-site rules for the
-        #    line's host first, then global rules (regex_rate_limiter.go:175-211)
+        # 3a. device window pass: fold the whole batch of match events into
+        #     the persistent on-device counters in one step, then replay the
+        #     per-event outcomes into results/effectors in reference order
+        if self.device_windows is not None:
+            self._apply_device_windows(work, bits, results)
+            return results
+
+        # 3b. host window pass in original line order: per-site rules for the
+        #     line's host first, then global rules (regex_rate_limiter.go:175-211)
         for row, (i, p) in enumerate(work):
             rule_order = self._per_site_idx.get(p.host, []) + self._global_idx
             try:
@@ -176,6 +221,62 @@ class TpuMatcher(Matcher):
 
     def close(self) -> None:
         """No buffered state: consume_lines is synchronous per batch."""
+
+    def _apply_device_windows(self, work, bits, results) -> None:
+        """Device window path: one _apply_step per batch, then host-side
+        replay of the per-event outcomes (same observable sequence as the
+        host pass: rule_results in per-site-then-global order, Banner side
+        effects per exceeded event)."""
+        from banjax_tpu.matcher.windows import split_ns
+
+        slots = self.device_windows.slots_for_ips([p.ip for _, p in work])
+        if slots is None:
+            # more distinct IPs than free+evictable slots: splitting the
+            # batch lets earlier lines' events land before their slots can
+            # be evicted for later lines (single-line batches always fit)
+            mid = max(1, len(work) // 2)
+            self._apply_device_windows(work[:mid], bits[:mid], results)
+            self._apply_device_windows(work[mid:], bits[mid:], results)
+            return
+        ts_s, ts_ns = split_ns(np.array([p.timestamp_ns for _, p in work]))
+        host_idx = np.array(
+            [self._host_row.get(p.host, 0) for _, p in work], dtype=np.int32
+        )
+        events = self.device_windows.apply_bitmap(
+            bits, slots, ts_s, ts_ns, self._active_table, host_idx
+        )
+        evmap = {(e.line, e.rule_id): e for e in events}
+
+        for row, (i, p) in enumerate(work):
+            rule_order = self._per_site_idx.get(p.host, []) + self._global_idx
+            try:
+                for idx in rule_order:
+                    _, rule = self._entries[idx]
+                    if not bits[row, idx]:
+                        continue
+                    result = RuleResult(rule_name=rule.rule, regex_match=True)
+                    if rule.hosts_to_skip.get(p.host):
+                        result.skip_host = True
+                        results[i].rule_results.append(result)
+                        continue
+                    result.skip_host = False
+                    e = evmap[(row, idx)]
+                    result.seen_ip = e.seen_ip
+                    result.rate_limit_result = RateLimitResult(
+                        match_type=e.match_type, exceeded=e.exceeded
+                    )
+                    if e.exceeded:
+                        self.banner.ban_or_challenge_ip(
+                            self.config, p.ip, rule.decision, p.host
+                        )
+                        self.banner.log_regex_ban(
+                            self.config, p.timestamp_ns / 1e9, p.ip,
+                            rule.rule, p.rest, rule.decision,
+                        )
+                    results[i].rule_results.append(result)
+            except Exception:  # noqa: BLE001 — a failing effector loses one line, not the batch
+                log.exception("error applying rules to log line")
+                results[i].error = True
 
     # ---- internals ----
 
@@ -199,14 +300,13 @@ class TpuMatcher(Matcher):
                     self._pallas_prep, pad_cls, pad_len,
                     interpret=self._pallas_interpret, packed=True,
                 )
-                out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
             else:
                 packed = np.asarray(
                     nfa_jax.match_batch_packed(
                         self._params, pad_cls, pad_len, self.compiled.n_rules
                     )
                 )
-                out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
+            out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
             bits[rows] = out[: len(rows)]
 
         # host fallback: whole lines the device can't decide

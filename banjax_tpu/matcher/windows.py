@@ -1,0 +1,502 @@
+"""Device-side fixed-window rate-limit counters (SURVEY.md §7.1 hard part #3).
+
+The reference applies its per-(IP, rule) fixed-window counters serially, one
+matched line at a time, under a mutex (/root/reference/internal/
+rate_limit.go:37-78). This module keeps the counters resident on the TPU as
+flat [capacity * n_rules] arrays and folds a whole batch of match events into
+them in one jitted step:
+
+  match bitmap [B, R]  (straight from the NFA kernel, never pulled to host)
+    → mask by per-host rule applicability / hosts_to_skip
+    → compact to an event list (line, rule) via fixed-capacity nonzero
+    → stable-sort by (slot, rule) key — row-major nonzero order IS the
+      reference's processing order (per-site rule ids precede global ids,
+      so (line, rule_id) ascending == the per-site-then-global loop of
+      regex_rate_limiter.go:175-211)
+    → one lax.scan over the sorted events: per segment, load the persistent
+      (hits, start) state, replay the exact window transitions, flag
+      exceeded events, write the segment's final state back
+    → return the compact per-event (match_type, exceeded, seen_ip) plus the
+      bit-packed match bitmap for host-side result reconstruction.
+
+Exactness: the host oracle (decisions/rate_limit.py, itself a port of
+rate_limit.go) compares int64 nanoseconds; TPUs have no native int64, so
+timestamps ride as (seconds, nanoseconds) int32 pairs and every comparison
+uses borrow arithmetic — bit-identical to the int64 path, including the
+contract quirks: window restart strictly-greater-than interval, hits reset
+to 0 (not 1) on exceed, FirstTime/OutsideInterval/InsideInterval match
+types, and seen_ip = "the IP had any state before this event".
+
+IP slots are assigned host-side (dict + LRU); evicting a slot queues a
+device-side row clear that runs at the start of the next apply step, so the
+device never needs a host round-trip mid-batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from banjax_tpu.config.schema import RegexWithRate
+from banjax_tpu.decisions.rate_limit import (
+    NumHitsAndIntervalStart,
+    RateLimitMatchType,
+)
+
+_NS_PER_S = 1_000_000_000
+
+
+def split_ns(ts_ns) -> Tuple[np.ndarray, np.ndarray]:
+    """int64 ns → (seconds, subsecond ns) int32 pair; exact for epoch times."""
+    ts_ns = np.asarray(ts_ns, dtype=np.int64)
+    s, ns = np.divmod(ts_ns, _NS_PER_S)  # floored divmod: ns always in [0, 1e9)
+    return s.astype(np.int32), ns.astype(np.int32)
+
+
+def _pair_gt(a_s, a_ns, b_s, b_ns):
+    """(a_s, a_ns) > (b_s, b_ns) lexicographically — int64 compare, split."""
+    return (a_s > b_s) | ((a_s == b_s) & (a_ns > b_ns))
+
+
+def _pair_sub(a_s, a_ns, b_s, b_ns):
+    """(a - b) as a normalized (s, ns) pair with borrow; may be negative s."""
+    ds = a_s - b_s
+    dns = a_ns - b_ns
+    borrow = dns < 0
+    return ds - borrow.astype(ds.dtype), dns + borrow.astype(dns.dtype) * _NS_PER_S
+
+
+@dataclasses.dataclass
+class DeviceWindowState:
+    """The donated device arrays (flat key = slot * n_rules + rule)."""
+
+    hits: jnp.ndarray      # [cap * R] int32
+    start_s: jnp.ndarray   # [cap * R] int32
+    start_ns: jnp.ndarray  # [cap * R] int32
+    valid: jnp.ndarray     # [cap * R] bool — state exists for this key
+    ip_seen: jnp.ndarray   # [cap] bool — slot has any state (seen_ip flag)
+
+
+@jax.jit
+def _count_events(bits, active_table, host_idx):
+    """Pre-pass: event count — the overflow check before any state mutation."""
+    fire = (bits != 0) & active_table[host_idx]
+    return fire.sum(dtype=jnp.int32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_rules", "max_events"),
+    donate_argnums=(0,),
+)
+def _apply_step(
+    state: DeviceWindowState,
+    bits: jnp.ndarray,         # [B, R] uint8/bool match bitmap (device)
+    active_table: jnp.ndarray,  # [H, R] bool — rule applicable & not hosts_to_skip
+    host_idx: jnp.ndarray,     # [B] int32 row of active_table per line
+    slot_ids: jnp.ndarray,     # [B] int32 (slot per line)
+    ts_s: jnp.ndarray,         # [B] int32
+    ts_ns: jnp.ndarray,        # [B] int32
+    limits: jnp.ndarray,       # [R] int32 hits_per_interval
+    iv_s: jnp.ndarray,         # [R] int32 interval seconds part
+    iv_ns: jnp.ndarray,        # [R] int32 interval ns part
+    evict: jnp.ndarray,        # [K] int32 slots to clear first (-1 = none)
+    *,
+    n_rules: int,
+    max_events: int,
+):
+    cap_r = state.hits.shape[0]
+
+    # 0. queued evictions: clear each evicted slot's rows + seen flag
+    ev_base = jnp.where(evict >= 0, evict * n_rules, cap_r)  # drop when -1
+    ev_keys = (ev_base[:, None] + jnp.arange(n_rules, dtype=jnp.int32)[None, :]).ravel()
+    valid = state.valid.at[ev_keys].set(False, mode="drop")
+    ip_seen = state.ip_seen.at[jnp.where(evict >= 0, evict, state.ip_seen.shape[0])].set(
+        False, mode="drop"
+    )
+
+    fire = (bits != 0) & active_table[host_idx]
+
+    # 1. fixed-capacity compaction in row-major (= reference processing) order
+    lines, rules = jnp.nonzero(
+        fire, size=max_events, fill_value=(jnp.int32(-1), jnp.int32(-1))
+    )
+    pad = lines < 0
+    slot = jnp.where(pad, jnp.int32(0), slot_ids[lines])
+    key = jnp.where(pad, jnp.int32(cap_r), slot * n_rules + rules)  # pad sorts last
+    seq = jnp.arange(max_events, dtype=jnp.int32)
+
+    # 2. stable sort by key (ties keep row-major order)
+    order = jnp.lexsort((seq, key))
+    key_s = key[order]
+    lines_s = lines[order]
+    rules_s = jnp.where(key_s >= cap_r, jnp.int32(0), rules[order])
+    e_ts_s = ts_s[jnp.maximum(lines_s, 0)]
+    e_ts_ns = ts_ns[jnp.maximum(lines_s, 0)]
+    pad_s = key_s >= cap_r
+
+    # seen_ip: slot already seen on device, or an earlier event in this batch
+    # touched the slot (reference: the per-IP dict exists, rate_limit.go:72-79)
+    first_seq = jnp.full((state.ip_seen.shape[0],), max_events, dtype=jnp.int32)
+    first_seq = first_seq.at[slot].min(
+        jnp.where(pad, max_events, seq), mode="drop"
+    )
+    seen_ip_ev = ip_seen[slot] | (seq > first_seq[slot])  # post-eviction flags
+    seen_ip_s = seen_ip_ev[order]
+
+    # 3. segment boundaries + persistent state gather per event
+    prev_key = jnp.concatenate([jnp.full((1,), -1, dtype=key_s.dtype), key_s[:-1]])
+    boundary = key_s != prev_key
+    g_hits = state.hits[jnp.minimum(key_s, cap_r - 1)]
+    g_ss = state.start_s[jnp.minimum(key_s, cap_r - 1)]
+    g_sns = state.start_ns[jnp.minimum(key_s, cap_r - 1)]
+    g_valid = valid[jnp.minimum(key_s, cap_r - 1)] & ~pad_s
+
+    lim_e = limits[rules_s]
+    ivs_e = iv_s[rules_s]
+    ivns_e = iv_ns[rules_s]
+
+    def step(carry, xs):
+        c_hits, c_ss, c_sns = carry
+        (b, gh, gs, gn, gv, ets, etn, lim, ivs, ivn, is_pad) = xs
+        h0 = jnp.where(b, gh, c_hits)
+        s0 = jnp.where(b, gs, c_ss)
+        n0 = jnp.where(b, gn, c_sns)
+        have = jnp.where(b, gv, True)
+
+        ds, dns = _pair_sub(ets, etn, s0, n0)
+        outside = have & _pair_gt(ds, dns, ivs, ivn)
+        restart = ~have | outside
+        h1 = jnp.where(restart, jnp.int32(1), h0 + 1)
+        s1 = jnp.where(restart, ets, s0)
+        n1 = jnp.where(restart, etn, n0)
+        exceeded = h1 > lim
+        h2 = jnp.where(exceeded, jnp.int32(0), h1)
+        mtype = jnp.where(
+            ~have, jnp.int32(0), jnp.where(outside, jnp.int32(1), jnp.int32(2))
+        )
+        # padding events must not perturb the carry (they share key cap_r,
+        # so they're their own segment — but keep them inert regardless)
+        h2 = jnp.where(is_pad, c_hits, h2)
+        s1 = jnp.where(is_pad, c_ss, s1)
+        n1 = jnp.where(is_pad, c_sns, n1)
+        return (h2, s1, n1), (h2, s1, n1, mtype, exceeded)
+
+    init = (jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    xs = (
+        boundary, g_hits, g_ss, g_sns, g_valid,
+        e_ts_s, e_ts_ns, lim_e, ivs_e, ivns_e, pad_s,
+    )
+    _, (f_hits, f_ss, f_sns, mtype, exceeded) = jax.lax.scan(step, init, xs)
+
+    # 4. write back each segment's final state (last event of each key)
+    next_key = jnp.concatenate([key_s[1:], jnp.full((1,), -2, dtype=key_s.dtype)])
+    is_last = (key_s != next_key) & ~pad_s
+    wb_key = jnp.where(is_last, key_s, jnp.int32(cap_r))  # drop non-last
+    hits = state.hits.at[wb_key].set(f_hits, mode="drop")
+    start_s = state.start_s.at[wb_key].set(f_ss, mode="drop")
+    start_ns = state.start_ns.at[wb_key].set(f_sns, mode="drop")
+    valid = valid.at[wb_key].set(True, mode="drop")
+    ip_seen = ip_seen.at[jnp.where(pad, state.ip_seen.shape[0], slot)].set(
+        True, mode="drop"
+    )
+
+    new_state = DeviceWindowState(
+        hits=hits, start_s=start_s, start_ns=start_ns, valid=valid, ip_seen=ip_seen
+    )
+    out = {
+        "line": lines_s,
+        "rule": jnp.where(pad_s, jnp.int32(-1), rules_s),
+        "match_type": mtype,
+        "exceeded": exceeded & ~pad_s,
+        "seen_ip": seen_ip_s,
+    }
+    return new_state, out
+
+
+jax.tree_util.register_dataclass(
+    DeviceWindowState,
+    data_fields=["hits", "start_s", "start_ns", "valid", "ip_seen"],
+    meta_fields=[],
+)
+
+
+@dataclasses.dataclass
+class WindowEvent:
+    """One applied (line, rule) window transition, in reference order."""
+
+    line: int
+    rule_id: int
+    match_type: RateLimitMatchType
+    exceeded: bool
+    seen_ip: bool
+
+
+class DeviceWindows:
+    """Device-resident RegexRateLimitStates with host slot management.
+
+    Authoritative when `matcher_device_windows: true`; mirrors the host
+    class's introspection surface (`get`, `format_states`, `__len__`) by
+    pulling only the requested slots back from the device.
+    """
+
+    def __init__(
+        self,
+        rules: Sequence[RegexWithRate],
+        capacity: int = 16384,  # the matcher_window_capacity config default
+        max_events: int = 4096,
+    ):
+        self.n_rules = max(1, len(rules))
+        self.capacity = capacity
+        # a single line can fire every rule; max_events >= n_rules makes the
+        # overflow split terminate at B=1
+        self.max_events = max(max_events, self.n_rules)
+        self._lock = threading.Lock()
+
+        limits = np.zeros(self.n_rules, dtype=np.int32)
+        iv_s = np.zeros(self.n_rules, dtype=np.int32)
+        iv_ns = np.zeros(self.n_rules, dtype=np.int32)
+        self._rule_names: List[str] = []
+        for i, r in enumerate(rules):
+            limits[i] = r.hits_per_interval
+            iv_s[i], iv_ns[i] = divmod(int(r.interval_ns), _NS_PER_S)
+            self._rule_names.append(r.rule)
+        self._limits = jnp.asarray(limits)
+        self._iv_s = jnp.asarray(iv_s)
+        self._iv_ns = jnp.asarray(iv_ns)
+
+        self._slots: "OrderedDict[str, int]" = OrderedDict()  # ip → slot, LRU
+        self._slot_ip: Dict[int, str] = {}
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._pending_evict: List[int] = []
+        # insertion-order bookkeeping for byte-identical introspection: the
+        # host dict (rate_limit.go) orders IPs by first event and rules by
+        # first event per IP; FIRST_TIME events replay that order here
+        self._insertion: "OrderedDict[int, List[int]]" = OrderedDict()
+        self._state = self._fresh_state()
+
+    def _fresh_state(self) -> DeviceWindowState:
+        cap_r = self.capacity * self.n_rules
+        return DeviceWindowState(
+            hits=jnp.zeros((cap_r,), dtype=jnp.int32),
+            start_s=jnp.zeros((cap_r,), dtype=jnp.int32),
+            start_ns=jnp.zeros((cap_r,), dtype=jnp.int32),
+            valid=jnp.zeros((cap_r,), dtype=jnp.bool_),
+            ip_seen=jnp.zeros((self.capacity,), dtype=jnp.bool_),
+        )
+
+    # ---- slot management (host) ----
+
+    def slot_for_ip(self, ip: str) -> int:
+        slots = self.slots_for_ips([ip])
+        assert slots is not None  # a single IP always fits (capacity >= 1)
+        return int(slots[0])
+
+    def slots_for_ips(self, ips: Sequence[str]) -> Optional[np.ndarray]:
+        """Assign a slot per IP for one batch, atomically.
+
+        Slots touched by THIS batch are pinned: evicting and reusing a slot
+        mid-batch would fold two different IPs' counters into the same
+        (slot, rule) keys in one scan. If an allocation would have to evict
+        a pinned slot, returns None — the caller must split the batch.
+        """
+        with self._lock:
+            pinned: set = set()
+            out = np.empty(len(ips), dtype=np.int32)
+            for i, ip in enumerate(ips):
+                slot = self._slots.get(ip)
+                if slot is not None:
+                    self._slots.move_to_end(ip)
+                    pinned.add(slot)
+                    out[i] = slot
+                    continue
+                if not self._free:
+                    # evict the least-recently-used unpinned slot
+                    victim_ip = next(
+                        (k for k, v in self._slots.items() if v not in pinned),
+                        None,
+                    )
+                    if victim_ip is None:
+                        return None  # every slot pinned by this batch
+                    old_slot = self._slots.pop(victim_ip)
+                    self._pending_evict.append(old_slot)
+                    self._free.append(old_slot)
+                    self._insertion.pop(old_slot, None)
+                    self._slot_ip.pop(old_slot, None)
+                slot = self._free.pop()
+                self._slots[ip] = slot
+                self._slot_ip[slot] = ip
+                pinned.add(slot)
+                out[i] = slot
+            return out
+
+    def clear(self) -> None:
+        """Hot-reload semantics: drop all counters (decision.go Clear analog)."""
+        with self._lock:
+            self._slots.clear()
+            self._slot_ip.clear()
+            self._insertion.clear()
+            self._free = list(range(self.capacity - 1, -1, -1))
+            self._pending_evict = []
+            self._state = self._fresh_state()
+
+    def __len__(self) -> int:
+        # parity with RegexRateLimitStates.__len__: IPs with any state
+        with self._lock:
+            return len(self._insertion)
+
+    # ---- the batch step ----
+
+    def apply_bitmap(
+        self,
+        bits,                      # [B, R] device or host array
+        slot_ids: np.ndarray,      # [B] int32
+        ts_s: np.ndarray,
+        ts_ns: np.ndarray,
+        active_table,              # [H, R] bool (device-resident, cached by caller)
+        host_idx: np.ndarray,      # [B] int32 — row of active_table per line
+    ) -> List[WindowEvent]:
+        """Apply one batch; returns the events in reference order.
+
+        The event count is checked BEFORE any state mutation; a batch with
+        more matched events than max_events is split in half and each half
+        applied in order (a single line can produce at most n_rules events,
+        so max_events >= n_rules guarantees termination)."""
+        bits = jnp.asarray(bits)
+        active_table = jnp.asarray(active_table)
+        host_idx = np.asarray(host_idx, dtype=np.int32)
+        n = _count_events(bits, active_table, jnp.asarray(host_idx))
+        if int(n) > self.max_events:
+            mid = bits.shape[0] // 2
+            ev1 = self.apply_bitmap(
+                bits[:mid], slot_ids[:mid], ts_s[:mid], ts_ns[:mid],
+                active_table, host_idx[:mid],
+            )
+            ev2 = self.apply_bitmap(
+                bits[mid:], slot_ids[mid:], ts_s[mid:], ts_ns[mid:],
+                active_table, host_idx[mid:],
+            )
+            for e in ev2:
+                e.line += mid
+            return ev1 + ev2
+
+        with self._lock:
+            pend = self._pending_evict
+            self._pending_evict = []
+            k = 256
+            while k < len(pend):
+                k <<= 1
+            evict = np.full((k,), -1, dtype=np.int32)
+            evict[: len(pend)] = pend
+
+            new_state, out = _apply_step(
+                self._state,
+                bits,
+                active_table,
+                jnp.asarray(host_idx),
+                jnp.asarray(slot_ids, dtype=jnp.int32),
+                jnp.asarray(ts_s, dtype=jnp.int32),
+                jnp.asarray(ts_ns, dtype=jnp.int32),
+                self._limits,
+                self._iv_s,
+                self._iv_ns,
+                jnp.asarray(evict),
+                n_rules=self.n_rules,
+                max_events=self.max_events,
+            )
+            self._state = new_state
+
+        line = np.asarray(out["line"])
+        rule = np.asarray(out["rule"])
+        mtype = np.asarray(out["match_type"])
+        exceeded = np.asarray(out["exceeded"])
+        seen = np.asarray(out["seen_ip"])
+        events = [
+            WindowEvent(
+                line=int(line[k]),
+                rule_id=int(rule[k]),
+                match_type=RateLimitMatchType(int(mtype[k])),
+                exceeded=bool(exceeded[k]),
+                seen_ip=bool(seen[k]),
+            )
+            for k in np.flatnonzero(rule >= 0)
+        ]
+        # reference order: by (line, rule_id) — per-site ids precede global
+        events.sort(key=lambda e: (e.line, e.rule_id))
+        with self._lock:
+            for e in events:
+                if e.match_type is RateLimitMatchType.FIRST_TIME:
+                    slot = int(slot_ids[e.line])
+                    lst = self._insertion.setdefault(slot, [])
+                    if e.rule_id not in lst:
+                        lst.append(e.rule_id)
+        return events
+
+    # ---- introspection parity with RegexRateLimitStates ----
+
+    def _slot_states(
+        self, slot: int, rule_order: Sequence[int], host
+    ) -> Dict[str, NumHitsAndIntervalStart]:
+        """Decode one slot's valid (rule → state) dict from host arrays."""
+        hits, ss, sns, valid = host
+        base = slot * self.n_rules
+        out: Dict[str, NumHitsAndIntervalStart] = {}
+        for i in rule_order:
+            if valid[base + i]:
+                out[self._rule_names[i]] = NumHitsAndIntervalStart(
+                    int(hits[base + i]),
+                    int(ss[base + i]) * _NS_PER_S + int(sns[base + i]),
+                )
+        return out
+
+    def _pull_host(self, state: DeviceWindowState):
+        """One transfer per array (not per IP) for the introspection APIs."""
+        return (
+            np.asarray(state.hits), np.asarray(state.start_s),
+            np.asarray(state.start_ns), np.asarray(state.valid),
+        )
+
+    def get(self, ip: str) -> Tuple[Dict[str, NumHitsAndIntervalStart], bool]:
+        with self._lock:
+            slot = self._slots.get(ip)
+            if slot is None or slot not in self._insertion:
+                return {}, False  # seen at parse time but no event yet
+            rule_order = list(self._insertion[slot])
+            state = self._state
+        base = slot * self.n_rules
+        sl = slice(base, base + self.n_rules)
+        host = (
+            np.asarray(state.hits[sl]), np.asarray(state.start_s[sl]),
+            np.asarray(state.start_ns[sl]), np.asarray(state.valid[sl]),
+        )
+        return self._slot_states(0, rule_order, host), True
+
+    def format_states(self) -> str:
+        with self._lock:
+            rows = [
+                (slot, self._slot_ip[slot], list(order))
+                for slot, order in self._insertion.items()
+                if slot in self._slot_ip
+            ]
+            state = self._state
+        if not rows:
+            return ""
+        host = self._pull_host(state)
+        lines: List[str] = []
+        for slot, ip, rule_order in rows:
+            states = self._slot_states(slot, rule_order, host)
+            lines.append(f"{ip}:")
+            for rule, s in states.items():
+                lines.append(f"\t{rule}:")
+                lines.append(
+                    f"\t\tNumHitsAndIntervalStart({s.num_hits}, {s.interval_start_time_ns})"
+                )
+            lines.append("")
+        return "\n".join(lines) + ("\n" if lines else "")

@@ -53,10 +53,14 @@ global_decision_lists:
 
 
 def make_pair(yaml_text=CONFIG_YAML):
-    """Two matchers over independent state, same config text."""
+    """Three matchers over independent state, same config text: the CPU
+    oracle, the TPU matcher with host windows, and the TPU matcher with
+    device-resident windows (matcher/windows.py)."""
     out = []
-    for cls in (CpuMatcher, TpuMatcher):
+    for cls, dev_windows in ((CpuMatcher, False), (TpuMatcher, False),
+                             (TpuMatcher, True)):
         config = config_from_yaml_text(yaml_text)
+        config.matcher_device_windows = dev_windows
         states = RegexRateLimitStates()
         banner = MockBanner()
         matcher = cls(config, banner, StaticDecisionLists(config), states)
@@ -85,18 +89,24 @@ def result_key(r):
 
 
 def assert_identical_consumption(lines, yaml_text=CONFIG_YAML):
-    (cpu, cpu_states, cpu_banner), (tpu, tpu_states, tpu_banner) = make_pair(yaml_text)
+    (cpu, cpu_states, cpu_banner), host_win, dev_win = make_pair(yaml_text)
     now = time.time()
     cpu_results = [cpu.consume_line(l, now_unix=now) for l in lines]
-    tpu_results = tpu.consume_lines(lines, now_unix=now)
-    for i, (a, b) in enumerate(zip(cpu_results, tpu_results)):
-        assert result_key(a) == result_key(b), f"line {i}: {lines[i]!r}"
-    assert [(b.ip, b.decision, b.domain) for b in cpu_banner.bans] == [
-        (b.ip, b.decision, b.domain) for b in tpu_banner.bans
-    ]
-    assert cpu_banner.regex_ban_logs == tpu_banner.regex_ban_logs
-    assert cpu_states.format_states() == tpu_states.format_states()
-    return tpu
+    for label, (tpu, tpu_states, tpu_banner) in (
+        ("host-windows", host_win), ("device-windows", dev_win),
+    ):
+        tpu_results = tpu.consume_lines(lines, now_unix=now)
+        for i, (a, b) in enumerate(zip(cpu_results, tpu_results)):
+            assert result_key(a) == result_key(b), (
+                f"{label} line {i}: {lines[i]!r}"
+            )
+        assert [(b.ip, b.decision, b.domain) for b in cpu_banner.bans] == [
+            (b.ip, b.decision, b.domain) for b in tpu_banner.bans
+        ], label
+        assert cpu_banner.regex_ban_logs == tpu_banner.regex_ban_logs, label
+        view = tpu.device_windows if tpu.device_windows is not None else tpu_states
+        assert cpu_states.format_states() == view.format_states(), label
+    return host_win[0]
 
 
 def ts(offset):
@@ -215,13 +225,14 @@ class TestGenerativeStress:
             )
         rng.shuffle(lines)
 
-        (cpu, _, cpu_banner), (tpu, _, tpu_banner) = make_pair(yaml_text)
+        (cpu, _, cpu_banner), *tpu_variants = make_pair(yaml_text)
         now = time.time()
         cpu_results = [cpu.consume_line(l, now_unix=now) for l in lines]
-        tpu_results = tpu.consume_lines(lines, now_unix=now)
-        for a, b in zip(cpu_results, tpu_results):
-            assert result_key(a) == result_key(b)
-        # every line tripped exactly one rule
-        assert all(len(r.rule_results) == 1 for r in tpu_results)
-        assert cpu_banner.regex_ban_logs == tpu_banner.regex_ban_logs
-        assert len(tpu_banner.bans) == n_rules
+        for tpu, _, tpu_banner in tpu_variants:
+            tpu_results = tpu.consume_lines(lines, now_unix=now)
+            for a, b in zip(cpu_results, tpu_results):
+                assert result_key(a) == result_key(b)
+            # every line tripped exactly one rule
+            assert all(len(r.rule_results) == 1 for r in tpu_results)
+            assert cpu_banner.regex_ban_logs == tpu_banner.regex_ban_logs
+            assert len(tpu_banner.bans) == n_rules
