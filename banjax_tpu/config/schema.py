@@ -153,6 +153,9 @@ class Config:
     # (rule ids reindex); the reference keeps them (keyed by rule name).
     matcher_device_windows: bool = False
     matcher_window_capacity: int = 16384  # IP slots (LRU-evicted)
+    # two-stage literal prefilter (matcher/prefilter.py): bit-identical
+    # output, auto-disabled for rulesets with too few filterable rules
+    matcher_prefilter: bool = True
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -182,7 +185,7 @@ _SCALAR_KEYS = {
     "session_cookie_not_verify": bool, "dnet": str, "standalone_testing": bool,
     "matcher": str, "matcher_batch_lines": int, "matcher_max_line_len": int,
     "matcher_backend": str, "matcher_device_windows": bool,
-    "matcher_window_capacity": int,
+    "matcher_window_capacity": int, "matcher_prefilter": bool,
 }
 
 _DICT_OR_LIST_KEYS = {

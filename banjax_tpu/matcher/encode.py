@@ -65,6 +65,60 @@ def parse_line(line_text: str, now_unix: float, old_cutoff_seconds: float = 10.0
     return p
 
 
+def encode_lines(
+    lines: Sequence[Union[str, bytes]], max_len: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Byte strings → ([B, max_len] uint8 byte matrix, lens, host_eval).
+
+    Vectorized (no per-line numpy work): one blob concatenation plus fancy
+    indexing — the host side of the match path runs at memory speed instead
+    of the Python interpreter's. Class mapping is per-ruleset and therefore
+    separate (encode_for_match); the byte matrix itself is ruleset-agnostic
+    so two-stage matching (matcher/prefilter.py) encodes bytes once.
+    """
+    B = len(lines)
+    raws = [
+        s.encode("utf-8", "surrogatepass") if isinstance(s, str) else s
+        for s in lines
+    ]
+    lens_all = np.fromiter((len(r) for r in raws), dtype=np.int64, count=B)
+    host_eval = lens_all > max_len
+
+    keep_idx = np.flatnonzero(~host_eval)
+    kept_lens = lens_all[keep_idx]
+    blob = b"".join(raws[i] for i in keep_idx)
+    flat = np.frombuffer(blob, dtype=np.uint8)
+
+    mat = np.zeros((keep_idx.size, max_len), dtype=np.uint8)
+    if flat.size:
+        starts = np.zeros(keep_idx.size, dtype=np.int64)
+        np.cumsum(kept_lens[:-1], out=starts[1:])
+        rows = np.repeat(np.arange(keep_idx.size), kept_lens)
+        cols = np.arange(flat.size, dtype=np.int64) - np.repeat(starts, kept_lens)
+        mat[rows, cols] = flat
+
+    non_ascii = (mat > 0x7F).any(axis=1)
+    if non_ascii.any():
+        host_eval[keep_idx[non_ascii]] = True
+        mat[non_ascii] = 0
+        kept_lens = np.where(non_ascii, 0, kept_lens)
+
+    bytes_mat = np.zeros((B, max_len), dtype=np.uint8)
+    bytes_mat[keep_idx] = mat
+    lens = np.zeros(B, dtype=np.int32)
+    lens[keep_idx] = kept_lens.astype(np.int32)
+    return bytes_mat, lens, host_eval
+
+
+def classify_bytes(
+    compiled: CompiledRules, bytes_mat: np.ndarray, lens: np.ndarray
+) -> np.ndarray:
+    """[B, L] bytes → [B, L] int32 class ids; pad positions get class 0."""
+    cls = compiled.byte_to_class[bytes_mat]
+    cls[np.arange(bytes_mat.shape[1])[None, :] >= lens[:, None]] = 0
+    return np.ascontiguousarray(cls, dtype=np.int32)
+
+
 def encode_for_match(
     compiled: CompiledRules,
     lines: Sequence[Union[str, bytes]],
@@ -75,22 +129,5 @@ def encode_for_match(
     Pad bytes get class 0, whose b_table row is all zeros, so device state
     collapses past end-of-line with no explicit length masking.
     """
-    B = len(lines)
-    cls_ids = np.zeros((B, max_len), dtype=np.int32)
-    lens = np.zeros(B, dtype=np.int32)
-    host_eval = np.zeros(B, dtype=bool)
-    table = compiled.byte_to_class
-    for i, raw in enumerate(lines):
-        if isinstance(raw, str):
-            raw = raw.encode("utf-8", "surrogatepass")
-        n = len(raw)
-        if n > max_len:
-            host_eval[i] = True
-            continue
-        arr = np.frombuffer(raw, dtype=np.uint8)
-        if n and arr.max() > 0x7F:
-            host_eval[i] = True
-            continue
-        cls_ids[i, :n] = table[arr]
-        lens[i] = n
-    return cls_ids, lens, host_eval
+    bytes_mat, lens, host_eval = encode_lines(lines, max_len)
+    return classify_bytes(compiled, bytes_mat, lens), lens, host_eval

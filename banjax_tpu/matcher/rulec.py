@@ -658,6 +658,66 @@ def compile_rule(pattern: str) -> RuleProgram:
 
 
 # ---------------------------------------------------------------------------
+# Required factors (for the literal prefilter, matcher/prefilter.py)
+# ---------------------------------------------------------------------------
+
+
+def _popcount(cs: int) -> int:
+    return bin(cs).count("1")
+
+
+def required_factors(
+    prog: RuleProgram,
+    min_len: int = 3,
+    max_len: int = 12,
+    max_class_size: int = 2,
+) -> Optional[List[Tuple[Pos, ...]]]:
+    """One necessary consecutive factor per branch, or None.
+
+    A factor is a run of non-self-loop positions whose byte classes are
+    narrow (size <= max_class_size, e.g. exact bytes or (?i) case pairs).
+    Any match of the branch must contain the factor's classes consecutively,
+    so "factor absent => branch cannot match" — the prefilter's soundness
+    invariant. Runs break at self-loop positions (`C+` can repeat, so bytes
+    around it are not consecutive); truncating a run keeps it necessary.
+    Returns None when any branch lacks a qualifying run (the rule must then
+    be matched against every line, prefilter or not).
+    """
+    if prog.always_match or prog.empty_only or not prog.branches:
+        return None
+    out: List[Tuple[Pos, ...]] = []
+    for br in prog.branches:
+        best: Tuple[Pos, ...] = ()
+        run: List[Pos] = []
+        for pos in list(br.positions) + [None]:  # sentinel flush
+            if (
+                pos is not None
+                and not pos.loop
+                and _popcount(pos.cs) <= max_class_size
+            ):
+                run.append(pos)
+                continue
+            if len(run) > len(best):
+                best = tuple(run)
+            run = []
+        if len(best) < min_len:
+            return None
+        if len(best) > max_len:
+            # middle slice: factor stays necessary, bounded state cost
+            start = (len(best) - max_len) // 2
+            best = best[start : start + max_len]
+        out.append(best)
+    return out
+
+
+def factor_program(factor: Tuple[Pos, ...]) -> RuleProgram:
+    """A factor as a one-branch unanchored search program."""
+    return RuleProgram(
+        branches=[Branch(tuple(Pos(p.cs) for p in factor), False, False)]
+    )
+
+
+# ---------------------------------------------------------------------------
 # Packing: all rules → tensors
 # ---------------------------------------------------------------------------
 
@@ -702,14 +762,14 @@ class CompiledRules:
         return int(sum(bin(int(w)).count("1") for w in used))
 
 
-def compile_rules(patterns: Sequence[str], n_shards: int = 1) -> CompiledRules:
+def compile_rules(patterns: Sequence[str], n_shards=1) -> CompiledRules:
     """Compile a full ruleset into one packed tensor set.
 
     `patterns[i]` keeps rule id `i` end to end, so the caller can map match
     bits straight back to its RegexWithRate list (global + per-site rules
-    concatenated, the way runner.py builds it).
+    concatenated, the way runner.py builds it). `n_shards="auto"` picks the
+    shard count that minimizes total padded words for the match kernel.
     """
-    n_rules = len(patterns)
     programs: List[Optional[RuleProgram]] = []
     unsupported: Dict[int, str] = {}
     for i, pat in enumerate(patterns):
@@ -718,6 +778,55 @@ def compile_rules(patterns: Sequence[str], n_shards: int = 1) -> CompiledRules:
         except UnsupportedPattern as e:
             programs.append(None)
             unsupported[i] = str(e)
+    return pack_programs(programs, n_shards=n_shards, unsupported=unsupported)
+
+
+_KERNEL_LANE_WORDS = 128   # the Pallas kernel pads each shard to this multiple
+_KERNEL_MAX_WPS = 512      # its per-shard VMEM comfort budget
+
+
+def choose_shards(branch_lengths: Sequence[int]) -> int:
+    """Exact-cost shard count: simulate the greedy branch packing for each
+    candidate and minimize `n_shards * pad(real_words_per_shard, lane)` —
+    the dot-row count the kernel actually pays (a ceil(total/ns) estimate
+    misses the packer's imbalance and can land just past a lane boundary)."""
+    if not branch_lengths:
+        return 1
+    order = sorted(branch_lengths, reverse=True)
+    total = sum(order)
+    best, best_cost = 1, None
+    max_ns = max(1, -(-total // (_KERNEL_LANE_WORDS * 32 // 2)))
+    for ns in range(1, max_ns + 1):
+        bits = [0] * ns
+        for ln in order:
+            s = min(range(ns), key=bits.__getitem__)
+            bits[s] += ln
+        wps = -(-max(bits) // 32)
+        wps_p = max(
+            _KERNEL_LANE_WORDS,
+            -(-wps // _KERNEL_LANE_WORDS) * _KERNEL_LANE_WORDS,
+        )
+        if wps_p > _KERNEL_MAX_WPS:
+            continue
+        cost = ns * wps_p
+        if best_cost is None or cost < best_cost:
+            best, best_cost = ns, cost
+    return best
+
+
+def pack_programs(
+    programs: Sequence[Optional[RuleProgram]],
+    n_shards=1,
+    unsupported: Optional[Dict[int, str]] = None,
+) -> CompiledRules:
+    """Pack already-lowered rule programs into the transition tensors.
+
+    Split out of compile_rules so synthetic programs (e.g. the literal
+    prefilter's factor automata, matcher/prefilter.py) share the packing
+    and the match kernels without a regex round-trip.
+    """
+    n_rules = len(programs)
+    unsupported = dict(unsupported or {})
 
     # gather branches: (rule_id, branch)
     all_branches: List[Tuple[int, Branch]] = []
@@ -726,6 +835,9 @@ def compile_rules(patterns: Sequence[str], n_shards: int = 1) -> CompiledRules:
             continue
         for br in prog.branches:
             all_branches.append((i, br))
+
+    if n_shards == "auto":
+        n_shards = choose_shards([len(b.positions) for _, b in all_branches])
 
     # shard assignment: greedy balance by bit length, branches atomic
     shard_bits = [0] * n_shards

@@ -143,25 +143,48 @@ class TpuMatcher(Matcher):
 
         if want_pallas:
             try:
-                comp = self.compiled
-                ns = pallas_nfa.auto_shards(comp.n_words)
-                if ns > comp.n_shards:
-                    # re-shard the ruleset so each shard's word slab fits
-                    # VMEM; byte classes are shard-independent by rulec
-                    # construction — encode uses self.compiled's table, so
-                    # check the invariant rather than trust it
-                    comp = compile_rules(
-                        [r.regex_string for _, r in self._entries], n_shards=ns
+                # re-shard for the kernel's VMEM/padding economics; byte
+                # classes are shard-independent by rulec construction —
+                # encode uses self.compiled's table, so check the invariant
+                # rather than trust it
+                comp = compile_rules(
+                    [r.regex_string for _, r in self._entries], n_shards="auto"
+                )
+                if not np.array_equal(
+                    comp.byte_to_class, self.compiled.byte_to_class
+                ):
+                    raise pallas_nfa.PallasUnsupported(
+                        "byte-class table changed across re-shard"
                     )
-                    if not np.array_equal(
-                        comp.byte_to_class, self.compiled.byte_to_class
-                    ):
-                        raise pallas_nfa.PallasUnsupported(
-                            "byte-class table changed across re-shard"
-                        )
                 self._pallas_prep = pallas_nfa.prepare(comp)
             except pallas_nfa.PallasUnsupported as e:
                 log.info("pallas matcher backend unavailable (%s); using XLA scan", e)
+
+        # two-stage literal prefilter (matcher/prefilter.py): compile-time
+        # rearrangement, bit-identical output; auto-disabled when the
+        # ruleset has too few filterable rules
+        self._prefilter = None
+        if getattr(config, "matcher_prefilter", True):
+            from banjax_tpu.matcher.prefilter import PrefilterMatcher, build_plan
+
+            try:
+                plan = build_plan([r.regex_string for _, r in self._entries])
+            except Exception:  # noqa: BLE001 — a plan bug must not kill the matcher
+                log.exception("prefilter plan construction failed; single-stage")
+                plan = None
+            if plan is not None:
+                if self._pallas_interpret:
+                    pf_backend = "pallas-interpret"
+                elif self._pallas_prep is not None:
+                    pf_backend = "pallas"
+                else:
+                    pf_backend = "xla"
+                try:
+                    self._prefilter = PrefilterMatcher(
+                        plan, pf_backend, self._max_len, self._max_batch
+                    )
+                except pallas_nfa.PallasUnsupported as e:
+                    log.info("prefilter unavailable (%s); single-stage", e)
 
     # ---- Matcher API ----
 
@@ -284,30 +307,36 @@ class TpuMatcher(Matcher):
         """[N, n_rules] uint8 — exact regex-match bitmap for each line."""
         n = len(parsed)
         rests = [p.rest for p in parsed]
-        cls_ids, lens, host_eval = encode_for_match(self.compiled, rests, self._max_len)
 
-        bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
-        device_rows = np.flatnonzero(~host_eval)
-        for start in range(0, len(device_rows), self._max_batch):
-            rows = device_rows[start : start + self._max_batch]
-            b = _bucket(len(rows), self._max_batch)
-            pad_cls = np.zeros((b, self._max_len), dtype=np.int32)
-            pad_len = np.zeros(b, dtype=np.int32)
-            pad_cls[: len(rows)] = cls_ids[rows]
-            pad_len[: len(rows)] = lens[rows]
-            if self._pallas_prep is not None:
-                packed = pallas_nfa.match_batch_pallas(
-                    self._pallas_prep, pad_cls, pad_len,
-                    interpret=self._pallas_interpret, packed=True,
-                )
-            else:
-                packed = np.asarray(
-                    nfa_jax.match_batch_packed(
-                        self._params, pad_cls, pad_len, self.compiled.n_rules
+        if self._prefilter is not None:
+            bits, host_eval = self._prefilter.match_bits(rests)
+            device_rows = np.flatnonzero(~host_eval)
+        else:
+            cls_ids, lens, host_eval = encode_for_match(
+                self.compiled, rests, self._max_len
+            )
+            bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
+            device_rows = np.flatnonzero(~host_eval)
+            for start in range(0, len(device_rows), self._max_batch):
+                rows = device_rows[start : start + self._max_batch]
+                b = _bucket(len(rows), self._max_batch)
+                pad_cls = np.zeros((b, self._max_len), dtype=np.int32)
+                pad_len = np.zeros(b, dtype=np.int32)
+                pad_cls[: len(rows)] = cls_ids[rows]
+                pad_len[: len(rows)] = lens[rows]
+                if self._pallas_prep is not None:
+                    packed = pallas_nfa.match_batch_pallas(
+                        self._pallas_prep, pad_cls, pad_len,
+                        interpret=self._pallas_interpret, packed=True,
                     )
-                )
-            out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
-            bits[rows] = out[: len(rows)]
+                else:
+                    packed = np.asarray(
+                        nfa_jax.match_batch_packed(
+                            self._params, pad_cls, pad_len, self.compiled.n_rules
+                        )
+                    )
+                out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
+                bits[rows] = out[: len(rows)]
 
         # host fallback: whole lines the device can't decide
         for row in np.flatnonzero(host_eval):

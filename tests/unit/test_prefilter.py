@@ -1,0 +1,139 @@
+"""Literal prefilter: factor soundness + two-stage bitmap equivalence.
+
+The invariant under test (matcher/prefilter.py): for every pattern, every
+match of a branch contains its required factor's classes consecutively, so
+gating stage 2 on "any factor hit" never drops a true match — the two-stage
+bitmap equals the single-stage one bit for bit.
+"""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from banjax_tpu.matcher import nfa_jax
+from banjax_tpu.matcher.encode import encode_for_match
+from banjax_tpu.matcher.prefilter import PrefilterMatcher, build_plan
+from banjax_tpu.matcher.rulec import (
+    compile_rule,
+    compile_rules,
+    required_factors,
+)
+
+
+def factor_to_str(factor):
+    """Pick one concrete byte per class (for eyeballing/containment checks)."""
+    return "".join(chr(min(b for b in range(256) if (p.cs >> b) & 1))
+                   for p in factor)
+
+
+class TestRequiredFactors:
+    def test_plain_literal(self):
+        f = required_factors(compile_rule(r"GET /wp-login\.php"))
+        assert f is not None and len(f) == 1
+        assert factor_to_str(f[0]) in "GET /wp-login.php"
+
+    def test_alternation_has_factor_per_branch(self):
+        f = required_factors(compile_rule(r"(GET|POST) /xmlrpc\.php"))
+        assert f is not None and len(f) == 2
+
+    def test_runs_break_at_selfloop(self):
+        # `admin[a-z]+panel` — the + position may repeat, so no factor may
+        # span it; both sides are valid factors though
+        f = required_factors(compile_rule(r"admin[a-z]+panel"))
+        assert f is not None
+        assert factor_to_str(f[0]) in ("admin", "panel")
+
+    def test_wide_class_blocks_factor(self):
+        assert required_factors(compile_rule(r"[a-z]{8}")) is None
+        assert required_factors(compile_rule(r"ab[0-9]cd")) is None  # runs of 2
+
+    def test_case_fold_pairs_allowed(self):
+        f = required_factors(compile_rule(r"(?i)sqlmap"))
+        assert f is not None
+        assert factor_to_str(f[0]).lower() in "sqlmap"
+
+    def test_always_match_rule_has_no_factor(self):
+        assert required_factors(compile_rule(r".*")) is None
+
+    def test_truncation_keeps_middle(self):
+        f = required_factors(compile_rule("a" * 30), max_len=8)
+        assert f is not None and len(f[0]) == 8
+
+    def test_factor_is_contained_in_random_matches(self):
+        """Generative soundness: synthesize matches, assert factor presence."""
+        rng = random.Random(5)
+        patterns = [
+            r"GET /admin/[a-z]+\.php", r"(?i)nikto|nessus",
+            r"POST /login[0-9]{1,3}", r"^HEAD /x\.cgi$",
+        ]
+        for pat in patterns:
+            prog = compile_rule(pat)
+            factors = required_factors(prog)
+            assert factors is not None, pat
+            for br, factor in zip(prog.branches, factors):
+                # synthesize a concrete match for this branch
+                s = ""
+                for p in br.positions:
+                    b = min(b for b in range(256) if (p.cs >> b) & 1)
+                    s += chr(b) * (1 + (2 if p.loop and rng.random() < 0.5 else 0))
+                assert re.search(pat, s), (pat, s)
+                # the factor's classes must appear consecutively somewhere
+                ok = any(
+                    all((factor[j].cs >> ord(s[k + j])) & 1 for j in range(len(factor)))
+                    for k in range(len(s) - len(factor) + 1)
+                )
+                assert ok, (pat, s, factor_to_str(factor))
+
+
+class TestTwoStageEquivalence:
+    def _bench_rules_and_lines(self, n_rules=60, n_lines=500, seed=9):
+        import bench
+
+        patterns = bench.generate_rules(n_rules, seed=seed)
+        lines = bench.generate_lines(n_lines, patterns, seed=seed + 1,
+                                     attack_rate=0.3)
+        return patterns, lines
+
+    def test_plan_builds_for_crs_shaped_rules(self):
+        patterns, _ = self._bench_rules_and_lines()
+        plan = build_plan(patterns)
+        assert plan is not None
+        assert plan.stage1.n_words < plan.stage2.n_words
+        assert plan.n_always + len(plan.f_idx) == len(
+            [p for i, p in enumerate(patterns) if i not in plan.unsupported]
+        )
+
+    @pytest.mark.parametrize("backend", ["xla", "pallas-interpret"])
+    def test_bitmap_equals_single_stage(self, backend):
+        patterns, lines = self._bench_rules_and_lines()
+        plan = build_plan(patterns)
+        assert plan is not None
+        pf = PrefilterMatcher(plan, backend, max_len=128, max_batch=256)
+        bits, host_eval = pf.match_bits(lines)
+        assert not host_eval.any()
+
+        compiled = compile_rules(patterns)
+        params = nfa_jax.match_params(compiled)
+        cls_ids, lens, he = encode_for_match(compiled, lines, 128)
+        want = np.asarray(
+            nfa_jax.match_batch(params, cls_ids, lens, compiled.n_rules)
+        )
+        for rid in plan.unsupported:
+            want[:, rid] = 0  # host-fallback columns are zero in both paths
+        assert (bits == want).all()
+
+    def test_default_rule_lands_in_always_group(self):
+        patterns = [r".*", r"GET /wp-login\.php", r"POST /xmlrpc\.php",
+                    r"/\.env", r"(?i)sqlmap"]
+        plan = build_plan(patterns, min_filterable_fraction=0.5)
+        assert plan is not None
+        assert 0 in set(plan.a_idx)
+        bits, _ = PrefilterMatcher(plan, "xla", max_len=64).match_bits(
+            ["GET x.com GET / HTTP/1.1"]
+        )
+        assert bits[0, 0] == 1  # .* matches everything, no factor needed
+
+    def test_unprofitable_ruleset_returns_none(self):
+        assert build_plan([r".*", r"[a-z]+", r"\d+"]) is None
