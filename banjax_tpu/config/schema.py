@@ -152,10 +152,21 @@ class Config:
     # the TPU instead of the host dict. Counters reset on config reload
     # (rule ids reindex); the reference keeps them (keyed by rule name).
     matcher_device_windows: bool = False
+    # IP slots for device windows. When distinct-IP cardinality exceeds this,
+    # the LRU IP's counters are evicted and FORGOTTEN (the reference's host
+    # dict never forgets) — rules under-enforce for rotated-back IPs. The
+    # DeviceWindows.eviction_count counter / metrics line surfaces pressure;
+    # size this above the expected concurrent distinct-IP count.
     matcher_window_capacity: int = 16384  # IP slots (LRU-evicted)
     # two-stage literal prefilter (matcher/prefilter.py): bit-identical
     # output, auto-disabled for rulesets with too few filterable rules
     matcher_prefilter: bool = True
+    # multi-device mesh (parallel/mesh.py): shard the line batch over `dp`
+    # devices and the packed NFA word axis over `rp` devices (dp * rp =
+    # matcher_mesh_devices). 0 = single-device. matcher_mesh_rp 0 = auto
+    # (widest power of two ≤ min(4, devices) that divides the device count).
+    matcher_mesh_devices: int = 0
+    matcher_mesh_rp: int = 0
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -186,6 +197,7 @@ _SCALAR_KEYS = {
     "matcher": str, "matcher_batch_lines": int, "matcher_max_line_len": int,
     "matcher_backend": str, "matcher_device_windows": bool,
     "matcher_window_capacity": int, "matcher_prefilter": bool,
+    "matcher_mesh_devices": int, "matcher_mesh_rp": int,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -259,6 +271,20 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
         raise ValueError(
             "config key matcher_window_capacity: expected a positive slot "
             f"count, got {cfg.matcher_window_capacity}"
+        )
+    if cfg.matcher_mesh_devices < 0 or cfg.matcher_mesh_rp < 0:
+        raise ValueError(
+            "config keys matcher_mesh_devices/matcher_mesh_rp: expected "
+            f"non-negative, got {cfg.matcher_mesh_devices}/{cfg.matcher_mesh_rp}"
+        )
+    if (
+        cfg.matcher_mesh_devices > 0
+        and cfg.matcher_mesh_rp > 0
+        and cfg.matcher_mesh_devices % cfg.matcher_mesh_rp != 0
+    ):
+        raise ValueError(
+            f"config key matcher_mesh_rp: {cfg.matcher_mesh_rp} does not "
+            f"divide matcher_mesh_devices {cfg.matcher_mesh_devices}"
         )
 
     return cfg

@@ -58,6 +58,9 @@ def check_re2_compatible(pattern: str) -> None:
 
 
 def _strip_classes_and_escapes(pattern: str) -> str:
+    # each class/escape is replaced by a placeholder atom (not dropped):
+    # dropping would make the quantifiers of e.g. `\d+\.\d+` adjacent and
+    # false-positive the possessive-quantifier scan as `++`
     out = []
     i = 0
     n = len(pattern)
@@ -68,6 +71,8 @@ def _strip_classes_and_escapes(pattern: str) -> str:
             if nxt.isdigit():
                 out.append(c)
                 out.append(nxt)  # keep backrefs visible to the scanner
+            else:
+                out.append("x")
             i += 2
             continue
         if c == "[":
@@ -82,6 +87,7 @@ def _strip_classes_and_escapes(pattern: str) -> str:
                     i += 1
                 i += 1
             i += 1  # closing ]
+            out.append("x")
             continue
         out.append(c)
         i += 1

@@ -76,6 +76,32 @@ class TpuMatcher(Matcher):
             self._global_idx.append(len(self._entries))
             self._entries.append((None, r))
 
+        # mesh mode: rule-parallel degree fixes the compile shard count so
+        # each rp member owns exactly one self-contained word slab
+        self._mesh = None
+        self._mesh_rp = 0
+        mesh_devices = getattr(config, "matcher_mesh_devices", 0) or 0
+        if mesh_devices > 0:
+            n_avail = len(jax.devices())
+            if mesh_devices > n_avail:
+                log.warning(
+                    "matcher_mesh_devices=%d but only %d JAX devices are "
+                    "attached; running single-device", mesh_devices, n_avail,
+                )
+            else:
+                rp = getattr(config, "matcher_mesh_rp", 0) or 0
+                if rp == 0:
+                    rp = 1
+                    while rp * 2 <= min(4, mesh_devices) and mesh_devices % (rp * 2) == 0:
+                        rp *= 2
+                if mesh_devices % rp != 0:
+                    raise ValueError(
+                        f"matcher_mesh_rp {rp} does not divide "
+                        f"matcher_mesh_devices {mesh_devices}"
+                    )
+                self._mesh_rp = rp
+                n_shards = rp
+
         self.compiled = compile_rules(
             [r.regex_string for _, r in self._entries], n_shards=n_shards
         )
@@ -141,7 +167,39 @@ class TpuMatcher(Matcher):
                     table[row, idx] = True
             self._active_table = jnp.asarray(table)
 
-        if want_pallas:
+        self._mesh_matcher = None
+        if self._mesh_rp:
+            from banjax_tpu.parallel.mesh import ShardedMatchBackend, make_mesh
+
+            self._mesh = make_mesh(mesh_devices, rp=self._mesh_rp)
+            if self._pallas_interpret:
+                mesh_backend = "pallas-interpret"
+            elif want_pallas:
+                mesh_backend = "pallas"
+            else:
+                mesh_backend = "xla"
+            # block granularity only matters for the compiled kernel; the
+            # XLA/interpret bodies shouldn't pad every batch to dp*128 rows
+            def _mk(backend):
+                return ShardedMatchBackend(
+                    self.compiled, self._mesh, self._max_len, backend=backend,
+                    block_b=128 if backend == "pallas" else 8,
+                )
+
+            try:
+                self._mesh_matcher = _mk(mesh_backend)
+            except pallas_nfa.PallasUnsupported as e:
+                log.info(
+                    "mesh pallas backend unavailable (%s); XLA-scan mesh", e
+                )
+                self._mesh_matcher = _mk("xla")
+            log.info(
+                "matcher mesh: dp=%d rp=%d backend=%s",
+                self._mesh.shape["dp"], self._mesh_rp,
+                self._mesh_matcher.backend,
+            )
+
+        if want_pallas and self._mesh_matcher is None:
             try:
                 # re-shard for the kernel's VMEM/padding economics; byte
                 # classes are shard-independent by rulec construction —
@@ -164,7 +222,12 @@ class TpuMatcher(Matcher):
         # rearrangement, bit-identical output; auto-disabled when the
         # ruleset has too few filterable rules
         self._prefilter = None
-        if getattr(config, "matcher_prefilter", True):
+        if self._mesh_matcher is not None and getattr(config, "matcher_prefilter", True):
+            log.info(
+                "prefilter not yet fused with the mesh path; running the "
+                "full sharded NFA per batch"
+            )
+        if getattr(config, "matcher_prefilter", True) and self._mesh_matcher is None:
             from banjax_tpu.matcher.prefilter import PrefilterMatcher, build_plan
 
             try:
@@ -254,6 +317,17 @@ class TpuMatcher(Matcher):
 
         slots = self.device_windows.slots_for_ips([p.ip for _, p in work])
         if slots is None:
+            if len(work) <= 1:
+                # a lone line can only fail allocation if every slot is
+                # pinned by in-flight batches — don't recurse forever
+                log.error(
+                    "device-windows slot allocation failed for a single line "
+                    "(capacity=%d, all slots pinned); dropping line",
+                    self.device_windows.capacity,
+                )
+                for i, _ in work:
+                    results[i].error = True
+                return
             # more distinct IPs than free+evictable slots: splitting the
             # batch lets earlier lines' events land before their slots can
             # be evicted for later lines (single-line batches always fit)
@@ -311,6 +385,16 @@ class TpuMatcher(Matcher):
         if self._prefilter is not None:
             bits, host_eval = self._prefilter.match_bits(rests)
             device_rows = np.flatnonzero(~host_eval)
+        elif self._mesh_matcher is not None:
+            cls_ids, lens, host_eval = encode_for_match(
+                self.compiled, rests, self._max_len
+            )
+            bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
+            device_rows = np.flatnonzero(~host_eval)
+            if device_rows.size:
+                bits[device_rows] = self._mesh_matcher.match_bits(
+                    cls_ids[device_rows], lens[device_rows]
+                )
         else:
             cls_ids, lens, host_eval = encode_for_match(
                 self.compiled, rests, self._max_len

@@ -52,6 +52,19 @@ from banjax_tpu.decisions.rate_limit import (
 
 _NS_PER_S = 1_000_000_000
 
+_MIN_ROW_BUCKET = 64
+
+
+def _bucket_rows(n: int) -> int:
+    """Pad batch row counts to powers of two: _apply_step is jitted with the
+    batch arrays' shapes as trace keys, so unbucketed sizes would compile a
+    fresh segmented-scan program per distinct B (unbounded jit-cache growth
+    in the hot path). Pad rows carry bits=0 and so produce no events."""
+    b = _MIN_ROW_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
 
 def split_ns(ts_ns) -> Tuple[np.ndarray, np.ndarray]:
     """int64 ns → (seconds, subsecond ns) int32 pair; exact for epoch times."""
@@ -276,6 +289,13 @@ class DeviceWindows:
         self._slot_ip: Dict[int, str] = {}
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         self._pending_evict: List[int] = []
+        # slots handed out by slots_for_ips stay pinned until the matching
+        # apply_bitmap consumes them, so a second caller's allocation can
+        # never evict a slot whose events are still in flight
+        self._pin_counts: Dict[int, int] = {}
+        # forget-on-evict: evicting a slot discards that IP's counters (the
+        # reference never forgets); this counter surfaces capacity pressure
+        self.eviction_count = 0
         # insertion-order bookkeeping for byte-identical introspection: the
         # host dict (rate_limit.go) orders IPs by first event and rules by
         # first event per IP; FIRST_TIME events replay that order here
@@ -297,6 +317,7 @@ class DeviceWindows:
     def slot_for_ip(self, ip: str) -> int:
         slots = self.slots_for_ips([ip])
         assert slots is not None  # a single IP always fits (capacity >= 1)
+        self._release_pins(slots)  # lookup only — no apply_bitmap will follow
         return int(slots[0])
 
     def slots_for_ips(self, ips: Sequence[str]) -> Optional[np.ndarray]:
@@ -318,24 +339,50 @@ class DeviceWindows:
                     out[i] = slot
                     continue
                 if not self._free:
-                    # evict the least-recently-used unpinned slot
+                    # evict the least-recently-used unpinned slot (skipping
+                    # both this batch's slots and any still in flight from a
+                    # prior slots_for_ips whose apply_bitmap hasn't run)
                     victim_ip = next(
-                        (k for k, v in self._slots.items() if v not in pinned),
+                        (
+                            k for k, v in self._slots.items()
+                            if v not in pinned and not self._pin_counts.get(v)
+                        ),
                         None,
                     )
                     if victim_ip is None:
-                        return None  # every slot pinned by this batch
+                        return None  # every slot pinned
                     old_slot = self._slots.pop(victim_ip)
                     self._pending_evict.append(old_slot)
                     self._free.append(old_slot)
                     self._insertion.pop(old_slot, None)
                     self._slot_ip.pop(old_slot, None)
+                    if self.eviction_count == 0:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "device-windows capacity (%d slots) exceeded; "
+                            "evicting LRU IP state (counters forgotten — "
+                            "raise matcher_window_capacity)", self.capacity,
+                        )
+                    self.eviction_count += 1
                 slot = self._free.pop()
                 self._slots[ip] = slot
                 self._slot_ip[slot] = ip
                 pinned.add(slot)
                 out[i] = slot
+            for slot in set(out.tolist()):
+                self._pin_counts[slot] = self._pin_counts.get(slot, 0) + 1
             return out
+
+    def _release_pins(self, slot_ids) -> None:
+        with self._lock:
+            for slot in set(np.asarray(slot_ids).tolist()):
+                slot = int(slot)
+                left = self._pin_counts.get(slot, 0) - 1
+                if left > 0:
+                    self._pin_counts[slot] = left
+                else:
+                    self._pin_counts.pop(slot, None)
 
     def clear(self) -> None:
         """Hot-reload semantics: drop all counters (decision.go Clear analog)."""
@@ -345,6 +392,7 @@ class DeviceWindows:
             self._insertion.clear()
             self._free = list(range(self.capacity - 1, -1, -1))
             self._pending_evict = []
+            self._pin_counts.clear()
             self._state = self._fresh_state()
 
     def __len__(self) -> int:
@@ -368,20 +416,43 @@ class DeviceWindows:
         The event count is checked BEFORE any state mutation; a batch with
         more matched events than max_events is split in half and each half
         applied in order (a single line can produce at most n_rules events,
-        so max_events >= n_rules guarantees termination)."""
+        so max_events >= n_rules guarantees termination). On return (even on
+        error) the batch's slot pins from slots_for_ips are released."""
+        try:
+            return self._apply_bitmap_inner(
+                bits, slot_ids, ts_s, ts_ns, active_table, host_idx
+            )
+        finally:
+            self._release_pins(slot_ids)
+
+    def _apply_bitmap_inner(
+        self, bits, slot_ids, ts_s, ts_ns, active_table, host_idx
+    ) -> List[WindowEvent]:
         bits = jnp.asarray(bits)
         active_table = jnp.asarray(active_table)
         host_idx = np.asarray(host_idx, dtype=np.int32)
+
+        # bucket B up to a power of two so _count_events/_apply_step compile
+        # once per bucket, not once per batch size (pad rows fire no events)
+        B = bits.shape[0]
+        Bp = _bucket_rows(B)
+        if Bp != B:
+            bits = jnp.pad(bits, ((0, Bp - B), (0, 0)))
+            slot_ids = np.pad(np.asarray(slot_ids, dtype=np.int32), (0, Bp - B))
+            ts_s = np.pad(np.asarray(ts_s, dtype=np.int32), (0, Bp - B))
+            ts_ns = np.pad(np.asarray(ts_ns, dtype=np.int32), (0, Bp - B))
+            host_idx = np.pad(host_idx, (0, Bp - B))
+
         n = _count_events(bits, active_table, jnp.asarray(host_idx))
         if int(n) > self.max_events:
-            mid = bits.shape[0] // 2
-            ev1 = self.apply_bitmap(
+            mid = B // 2
+            ev1 = self._apply_bitmap_inner(
                 bits[:mid], slot_ids[:mid], ts_s[:mid], ts_ns[:mid],
                 active_table, host_idx[:mid],
             )
-            ev2 = self.apply_bitmap(
-                bits[mid:], slot_ids[mid:], ts_s[mid:], ts_ns[mid:],
-                active_table, host_idx[mid:],
+            ev2 = self._apply_bitmap_inner(
+                bits[mid:B], slot_ids[mid:B], ts_s[mid:B], ts_ns[mid:B],
+                active_table, host_idx[mid:B],
             )
             for e in ev2:
                 e.line += mid

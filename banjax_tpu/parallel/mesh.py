@@ -14,19 +14,22 @@ edges with no shared state (SURVEY.md §2.3); the TPU-native equivalent is a
     carry; the only collective is one `psum` of accept bits over `rp`,
     riding ICI.
 
-Windows/Decisions stay host-side (runner.py), so this module is the entire
-multi-chip device step — the thing `__graft_entry__.dryrun_multichip`
-compiles and runs on an N-virtual-device mesh.
+The per-device body is the SAME Pallas kernel the single-chip product path
+runs (matcher/kernels/nfa_match.py) — each rp member scans its own word
+slab with a one-shard grid; `backend="xla"` swaps in the nfa_jax scan and
+`backend="pallas-interpret"` runs the kernel as plain JAX (the CPU-mesh CI
+and dryrun path). `ShardedMatchBackend` is the batch-level wrapper
+TpuMatcher plugs into `_match_bits` when a mesh is configured.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
     from jax import shard_map  # jax >= 0.8
@@ -34,6 +37,7 @@ except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
 from banjax_tpu.matcher import nfa_jax
+from banjax_tpu.matcher.kernels import nfa_match as pallas_nfa
 from banjax_tpu.matcher.rulec import CompiledRules
 
 
@@ -64,8 +68,38 @@ def _param_specs() -> Dict[str, P]:
     }
 
 
+def _extract_local(
+    acc,                 # [b, W_local] uint32 — this shard's accept words
+    lens_local,          # [b] int32
+    acc_word, acc_mask, branch_rule, always_match, empty_only,
+    n_rules: int,
+    words_per_shard: int,
+):
+    """Shard-local accept extraction + the rp psum combine (shared by the
+    XLA and Pallas bodies — the only collective in the device step)."""
+    shard = jax.lax.axis_index("rp")
+    local_w = acc_word - shard * words_per_shard
+    in_shard = (local_w >= 0) & (local_w < words_per_shard)
+    gw = jnp.clip(local_w, 0, words_per_shard - 1)
+    b = acc.shape[0]
+    if acc_word.shape[0] > 0:
+        sel = (acc[:, gw] & acc_mask) != 0  # [b, n_br]
+        sel = jnp.where(in_shard[None, :], sel, False)
+        sel = jax.lax.psum(sel.astype(jnp.uint8), "rp")
+        matched = jnp.zeros((b, n_rules), dtype=jnp.uint8)
+        matched = matched.at[:, branch_rule].max((sel > 0).astype(jnp.uint8))
+    else:
+        matched = jax.lax.psum(
+            jnp.zeros((b, n_rules), dtype=jnp.uint8), "rp"
+        )
+    matched = matched | always_match.astype(jnp.uint8)[None, :]
+    empty = (lens_local == 0)[:, None].astype(jnp.uint8)
+    matched = matched | (empty_only.astype(jnp.uint8)[None, :] * empty)
+    return matched
+
+
 def sharded_match_fn(compiled: CompiledRules, mesh: Mesh):
-    """Build the jitted multi-device match step.
+    """Build the jitted multi-device match step (XLA-scan body).
 
     Returns fn(params, cls_ids [B, L], lens [B]) → matched [B, n_rules]
     uint8, with B divisible by the dp axis size and compiled.n_shards equal
@@ -82,24 +116,12 @@ def sharded_match_fn(compiled: CompiledRules, mesh: Mesh):
     def local_step(params, cls_local, lens_local):
         # state scan over this device's word slice only
         acc = nfa_jax.nfa_scan(params, cls_local, lens_local)  # [b, W_local]
-        shard = jax.lax.axis_index("rp")
-        local_w = params["acc_word"] - shard * words_per_shard
-        in_shard = (local_w >= 0) & (local_w < words_per_shard)
-        gw = jnp.clip(local_w, 0, words_per_shard - 1)
-        sel = (acc[:, gw] & params["acc_mask"]) != 0  # [b, n_br]
-        sel = jnp.where(in_shard[None, :], sel, False)
-        # combine accept bits across the rule-parallel axis (ICI collective)
-        sel = jax.lax.psum(sel.astype(jnp.uint8), "rp")
-        b = cls_local.shape[0]
-        matched = jnp.zeros((b, n_rules), dtype=jnp.uint8)
-        if compiled.acc_word.shape[0] > 0:
-            matched = matched.at[:, params["branch_rule"]].max(
-                (sel > 0).astype(jnp.uint8)
-            )
-        matched = matched | params["always_match"].astype(jnp.uint8)[None, :]
-        empty = (lens_local == 0)[:, None].astype(jnp.uint8)
-        matched = matched | (params["empty_only"].astype(jnp.uint8)[None, :] * empty)
-        return matched
+        return _extract_local(
+            acc, lens_local,
+            params["acc_word"], params["acc_mask"], params["branch_rule"],
+            params["always_match"], params["empty_only"],
+            n_rules, words_per_shard,
+        )
 
     fn = shard_map(
         local_step,
@@ -120,6 +142,206 @@ def shard_params(
     params = nfa_jax.match_params(compiled)
     specs = _param_specs()
     return {
-        k: jax.device_put(v, jax.sharding.NamedSharding(mesh, specs[k]))
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
         for k, v in params.items()
     }
+
+
+# ---- Pallas per-device body (the production kernel under the mesh) ----
+
+
+def _pallas_specs() -> Dict[str, P]:
+    # btab_t rows are shard-major ([ns * 4 * wps_p, C_p]), masks_t likewise
+    # ([ns * wps_p, 8]): sharding axis 0 over rp hands each device exactly
+    # its own shard's slab
+    return {
+        "btab_t": P("rp", None),
+        "masks_t": P("rp", None),
+        "acc_word": P(),
+        "acc_mask": P(),
+        "branch_rule": P(),
+        "always_match": P(),
+        "empty_only": P(),
+    }
+
+
+def shard_pallas_params(
+    prep: pallas_nfa.PallasRules, mesh: Mesh
+) -> Dict[str, jnp.ndarray]:
+    """Device-put the kernel tensors with the mesh sharding applied."""
+    params = {
+        "btab_t": prep.btab_t,
+        "masks_t": prep.masks_t,
+        "acc_word": prep.acc_word,
+        "acc_mask": prep.acc_mask,
+        "branch_rule": prep.branch_rule,
+        "always_match": prep.always_match,
+        "empty_only": prep.empty_only,
+    }
+    specs = _pallas_specs()
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }
+
+
+def sharded_pallas_fn(
+    prep: pallas_nfa.PallasRules,
+    mesh: Mesh,
+    B: int,
+    L_p: int,
+    block_b: int,
+    interpret: bool = False,
+):
+    """Multi-device match step whose per-device body is the Pallas kernel.
+
+    fn(params, cls_t [L_p, B], lens [B]) → matched [B, n_rules] uint8.
+    B must be divisible by dp * block_b; prep.n_shards must equal rp.
+    """
+    dp, rp = mesh.shape["dp"], mesh.shape["rp"]
+    if prep.n_shards != rp:
+        raise ValueError(
+            f"ruleset prepared for {prep.n_shards} shards, mesh rp={rp}"
+        )
+    if B % (dp * block_b):
+        raise ValueError(
+            f"batch {B} must be a multiple of dp*block_b = {dp * block_b}"
+        )
+    b_local = B // dp
+    n_rules = prep.n_rules
+    wps_p = prep.wps_p
+    call = pallas_nfa._build_raw_call(
+        b_local, L_p, prep.n_classes_p, 1, wps_p, block_b, interpret
+    )
+
+    def local_step(params, cls_t_local, lens_local):
+        lens_row = lens_local[None, :]
+        maxtile = jnp.asarray(
+            -(-lens_local.reshape(b_local // block_b, block_b).max(axis=1)
+              // pallas_nfa._COLS_PER_STEP),
+            dtype=jnp.int32,
+        )
+        acc_t = call(
+            maxtile, cls_t_local, lens_row, params["btab_t"], params["masks_t"]
+        )  # [wps_p, b_local]
+        return _extract_local(
+            acc_t.T, lens_local,
+            params["acc_word"], params["acc_mask"], params["branch_rule"],
+            params["always_match"], params["empty_only"],
+            n_rules, wps_p,
+        )
+
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(_pallas_specs(), P(None, "dp"), P("dp")),
+        out_specs=P("dp", None),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+class ShardedMatchBackend:
+    """Batch-level mesh matcher: the drop-in device backend for TpuMatcher.
+
+    match_bits pads/permutes an encoded batch onto the dp axis (length-
+    sorted round-robin so every device gets a balanced mix of line lengths
+    for the kernel's tile skip), runs the sharded device step, and returns
+    the bitmap in the caller's original line order.
+    """
+
+    def __init__(
+        self,
+        compiled: CompiledRules,
+        mesh: Mesh,
+        max_len: int,
+        backend: str = "pallas",   # pallas | pallas-interpret | xla
+        block_b: int = 128,
+    ):
+        self.mesh = mesh
+        self.dp = mesh.shape["dp"]
+        self.rp = mesh.shape["rp"]
+        self.backend = backend
+        self.n_rules = compiled.n_rules
+        self.max_len = max_len
+        self.block_b = block_b
+        self._fns: Dict[Tuple[int, int], object] = {}
+        if backend == "xla":
+            self._prep = None
+            self._params = shard_params(compiled, mesh)
+            self._compiled = compiled
+        else:
+            self._prep = pallas_nfa.prepare(compiled)
+            self._params = shard_pallas_params(self._prep, mesh)
+            self._compiled = compiled
+
+    def _fn(self, B: int, L_p: int):
+        key = (B, L_p)
+        fn = self._fns.get(key)
+        if fn is None:
+            if self.backend == "xla":
+                fn = sharded_match_fn(self._compiled, self.mesh)
+            else:
+                fn = sharded_pallas_fn(
+                    self._prep, self.mesh, B, L_p, self.block_b,
+                    interpret=self.backend == "pallas-interpret",
+                )
+            self._fns[key] = fn
+        return fn
+
+    def match_bits(self, cls_ids: np.ndarray, lens: np.ndarray) -> np.ndarray:
+        """[B, L] encoded lines → [B, n_rules] uint8, any B (dp remainder
+        handled by padding; output order matches input order)."""
+        cls_ids = np.asarray(cls_ids, dtype=np.int32)
+        lens = np.asarray(lens, dtype=np.int32)
+        B, L = cls_ids.shape
+        chunk = self.dp * self.block_b
+        Bp = max(chunk, -(-B // chunk) * chunk)
+
+        # trim the scan to the longest real line (pad columns can't change
+        # state), keeping the jitted L_p variants to a multiple of 32
+        max_len = int(lens.max()) if B else 0
+        L_p = max(
+            pallas_nfa._COLS_PER_STEP,
+            min(
+                pallas_nfa._pad_to(L, pallas_nfa._COLS_PER_STEP),
+                pallas_nfa._pad_to(max_len, 32),
+            ),
+        )
+
+        # length-sorted round-robin over dp: device d gets sorted lines
+        # d, d+dp, d+2*dp, ... — balanced tile-skip work per device
+        order = np.argsort(lens, kind="stable")
+        perm = np.empty(Bp, dtype=np.int64)
+        rows_per_dev = Bp // self.dp
+        pos = 0
+        for d in range(self.dp):
+            idx = np.arange(d, Bp, self.dp)
+            perm[pos : pos + rows_per_dev] = idx
+            pos += rows_per_dev
+        # perm[k] = which padded-sorted row device-major slot k takes
+        cls_sorted = np.zeros((Bp, L_p), dtype=np.int32)
+        cls_sorted[:B, : min(L, L_p)] = cls_ids[order, : min(L, L_p)]
+        lens_sorted = np.zeros(Bp, dtype=np.int32)
+        lens_sorted[:B] = lens[order]
+        cls_dev = cls_sorted[perm]
+        lens_dev = lens_sorted[perm]
+
+        fn = self._fn(Bp, L_p)
+        if self.backend == "xla":
+            out = np.asarray(
+                fn(self._params, jnp.asarray(cls_dev), jnp.asarray(lens_dev))
+            )
+        else:
+            cls_t = np.ascontiguousarray(cls_dev.T)
+            out = np.asarray(
+                fn(self._params, jnp.asarray(cls_t), jnp.asarray(lens_dev))
+            )
+
+        # undo the device permutation, then the length sort
+        unperm = np.empty(Bp, dtype=np.int64)
+        unperm[perm] = np.arange(Bp)
+        out_sorted = out[unperm][:B]
+        unsorted = np.empty_like(out_sorted)
+        unsorted[order] = out_sorted
+        return unsorted

@@ -16,7 +16,10 @@ BASELINE.md; its serial Go loop is the functional, not numerical, baseline).
 from __future__ import annotations
 
 import json
+import os
 import random
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -27,6 +30,39 @@ BATCH = 8192
 MAX_LEN = 128
 WARMUP = 3
 ITERS = 10
+
+BACKEND_PROBE_TIMEOUT_S = 150
+BACKEND_PROBE_RETRIES = 2
+
+
+def _probe_backend() -> "tuple[str, str | None]":
+    """Decide the backend before jax initializes in this process.
+
+    TPU-tunnel init can hang indefinitely rather than raise, so the probe
+    runs `jax.devices()` in a subprocess under a timeout, with retry +
+    backoff. On repeated failure the bench falls back to host CPU so the
+    driver still gets its one JSON line, with the failure recorded in
+    "backend_error"."""
+    if os.environ.get("BENCH_CPU"):
+        return "cpu", None
+    err = None
+    for attempt in range(BACKEND_PROBE_RETRIES):
+        if attempt:
+            time.sleep(5 * attempt)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True,
+                timeout=BACKEND_PROBE_TIMEOUT_S,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                return r.stdout.strip().splitlines()[-1], None
+            err = f"probe rc={r.returncode}: {r.stderr.strip()[-300:]}"
+        except subprocess.TimeoutExpired:
+            err = (f"probe timeout after {BACKEND_PROBE_TIMEOUT_S}s "
+                   "(backend init hang)")
+    return "cpu", err
 
 
 def generate_rules(n: int, seed: int = 7) -> list:
@@ -127,15 +163,7 @@ def _time_chained(step, args, batch):
     return batch * ITERS / elapsed, elapsed / ITERS, first_call_s
 
 
-def main() -> None:
-    import os
-
-    import jax
-
-    if os.environ.get("BENCH_CPU"):
-        # the axon sitecustomize pins jax_platforms to the TPU tunnel; the
-        # config knob (not the env var) is what actually overrides it
-        jax.config.update("jax_platforms", "cpu")
+def run_bench(jax) -> dict:
     import jax.numpy as jnp
 
     from banjax_tpu.matcher import nfa_jax
@@ -171,6 +199,7 @@ def main() -> None:
     # small interpret-mode slice keeps the parity check.
     pallas_ok = backend == "tpu"
     interpret = False
+    prep = None
     try:
         prep = nfa_match.prepare(compiled_sharded)
         if not pallas_ok:
@@ -210,7 +239,7 @@ def main() -> None:
     if pallas_ok:
         got = nfa_match.match_batch_pallas(prep, cls_ids, lens)
         assert (got == out).all(), "pallas/XLA match bitmap divergence"
-    else:
+    elif prep is not None:
         n_check = 256  # interpret mode: parity on a slice, no timing
         got = nfa_match.match_batch_pallas(
             prep, cls_ids[:n_check], lens[:n_check], interpret=True
@@ -251,7 +280,7 @@ def main() -> None:
     best_lat = min(pallas_lat, xla_lat) if pallas_ok else xla_lat
     if pf_lps is not None and pf_lps > best_lps:
         best_lps, best_lat = pf_lps, pf_lat
-    print(json.dumps({
+    return {
         "metric": "log-lines/sec classified @1k rules (device NFA match)",
         "value": round(best_lps, 1),
         "unit": "lines/sec",
@@ -274,7 +303,32 @@ def main() -> None:
         "rule_compile_s": round(compile_s, 2),
         "first_call_s": round(pallas_first if pallas_ok else xla_first, 2),
         "line_match_rate": round(match_rate, 4),
-    }))
+    }
+
+
+def main() -> None:
+    requested, backend_error = _probe_backend()
+
+    result: dict
+    try:
+        import jax
+
+        if requested == "cpu":
+            # the axon sitecustomize pins jax_platforms to the TPU tunnel;
+            # the config knob (not the env var) is what actually overrides it
+            jax.config.update("jax_platforms", "cpu")
+        result = run_bench(jax)
+    except Exception as exc:  # always emit the one JSON line, never a traceback
+        result = {
+            "metric": "log-lines/sec classified @1k rules (device NFA match)",
+            "value": 0.0,
+            "unit": "lines/sec",
+            "vs_baseline": 0.0,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+    if backend_error:
+        result["backend_error"] = backend_error
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,68 @@
+"""Shared mesh-vs-oracle harness: TpuMatcher in mesh mode against the CPU
+reference matcher. Used by both tests/unit/test_parallel_mesh.py and the
+driver's __graft_entry__.dryrun_multichip so the comparison contract (the
+result-key tuple and the Banner effect sequence) lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from banjax_tpu.matcher.runner import TpuMatcher
+from tests.mock_banner import MockBanner
+
+
+def result_key(r):
+    """The full observable content of one ConsumeLineResult."""
+    return (
+        r.error, r.old_line, r.exempted,
+        tuple(
+            (
+                rr.rule_name, rr.regex_match, rr.skip_host, rr.seen_ip,
+                None if rr.rate_limit_result is None else (
+                    int(rr.rate_limit_result.match_type),
+                    rr.rate_limit_result.exceeded,
+                ),
+            )
+            for rr in r.rule_results
+        ),
+    )
+
+
+def build_matcher(cls, yaml_text, mesh_devices=0, mesh_rp=0,
+                  interpret=False, device_windows=False):
+    cfg = config_from_yaml_text(yaml_text)
+    cfg.matcher_mesh_devices = mesh_devices
+    cfg.matcher_mesh_rp = mesh_rp
+    if mesh_devices and interpret:
+        cfg.matcher_backend = "pallas-interpret"
+    cfg.matcher_device_windows = device_windows
+    banner = MockBanner()
+    m = cls(cfg, banner, StaticDecisionLists(cfg), RegexRateLimitStates())
+    return m, banner
+
+
+def assert_mesh_matches_cpu_oracle(
+    yaml_text, lines, now, n_devices, rp, *,
+    interpret=False, device_windows=False,
+):
+    """Consume `lines` through CpuMatcher and a mesh-mode TpuMatcher; assert
+    identical ConsumeLineResult streams and Banner side effects. Returns the
+    mesh TpuMatcher for further inspection."""
+    cpu_m, cpu_b = build_matcher(CpuMatcher, yaml_text)
+    tpu_m, tpu_b = build_matcher(
+        TpuMatcher, yaml_text, mesh_devices=n_devices, mesh_rp=rp,
+        interpret=interpret, device_windows=device_windows,
+    )
+    assert tpu_m._mesh_matcher is not None, "mesh mode did not engage"
+    want = [cpu_m.consume_line(l, now) for l in lines]
+    got = tpu_m.consume_lines(lines, now)
+    assert [result_key(r) for r in got] == [result_key(r) for r in want], (
+        "mesh TpuMatcher diverged from the CPU oracle"
+    )
+    assert [(b.ip, b.decision, b.domain) for b in tpu_b.bans] == [
+        (b.ip, b.decision, b.domain) for b in cpu_b.bans
+    ], "Banner side effects diverged"
+    return tpu_m

@@ -208,3 +208,48 @@ def test_capacity_overflow_batch_splits_identically():
     assert len(got) == len(want)
     for (l1, r1, *_), (l2, r2, *_) in zip(got, want):
         assert (l1, r1) == (l2, r2)
+
+
+def test_varying_batch_sizes_share_one_compile():
+    """apply_bitmap buckets B to powers of two before the jitted step, so
+    traffic with varying batch sizes must not grow the jit cache per size
+    (ADVICE r1: unbounded recompiles of the segmented-scan program)."""
+    from banjax_tpu.matcher import windows as W
+
+    rules = [make_rule("r", 10.0, 100)]
+    dw = DeviceWindows(rules, capacity=64)
+    active = np.ones((1, 1), dtype=bool)
+    base = 1_700_000_000 * NS
+    count_before = W._apply_step._cache_size()
+    for i, b in enumerate([1, 3, 5, 17, 33, 63, 64]):  # all bucket to 64
+        bits = np.ones((b, 1), dtype=np.uint8)
+        ips = [f"9.9.{i}.{j}" for j in range(b)]
+        slots = dw.slots_for_ips(ips)
+        ts = np.arange(b, dtype=np.int64) + base + i * NS
+        ts_s, ts_ns = split_ns(ts)
+        events = dw.apply_bitmap(
+            bits, slots, ts_s, ts_ns, active, np.zeros(b, dtype=np.int32)
+        )
+        assert len(events) == b
+        assert all(0 <= e.line < b for e in events)
+    assert W._apply_step._cache_size() - count_before == 1
+
+
+def test_in_flight_slots_not_evicted_and_pins_release():
+    """Slots assigned by slots_for_ips stay pinned (unevictable) until their
+    apply_bitmap runs, then the pins release so eviction works again."""
+    rules = [make_rule("r", 10.0, 100)]
+    dw = DeviceWindows(rules, capacity=2)
+    active = np.ones((1, 1), dtype=bool)
+    base = 1_700_000_000 * NS
+
+    slots_ab = dw.slots_for_ips(["a", "b"])  # fills capacity, pins both
+    assert dw.slots_for_ips(["c"]) is None   # nothing evictable while pinned
+    assert dw.eviction_count == 0
+
+    ts_s, ts_ns = split_ns(np.array([base, base + 1], dtype=np.int64))
+    dw.apply_bitmap(np.ones((2, 1), dtype=np.uint8), slots_ab, ts_s, ts_ns,
+                    active, np.zeros(2, dtype=np.int32))
+    slots_c = dw.slots_for_ips(["c"])        # pins released → LRU evictable
+    assert slots_c is not None
+    assert dw.eviction_count == 1

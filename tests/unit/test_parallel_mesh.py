@@ -66,3 +66,167 @@ def test_sharded_matches_single_device(dp, rp):
         rx = re.compile(pat)
         for i, line in enumerate(LINES):
             assert bool(got[i, j]) == (rx.search(line) is not None)
+
+
+@pytest.mark.parametrize("dp,rp", [(4, 2), (2, 4)])
+def test_sharded_pallas_backend_matches_oracle(dp, rp):
+    """The production mesh path: Pallas kernel per device (interpret mode on
+    the CPU mesh), via the batch-level ShardedMatchBackend."""
+    from banjax_tpu.parallel.mesh import ShardedMatchBackend
+
+    if len(jax.devices()) < dp * rp:
+        pytest.skip("needs 8 virtual devices")
+    compiled = compile_rules(PATTERNS, n_shards=rp)
+    mesh = make_mesh(dp * rp, rp=rp)
+    backend = ShardedMatchBackend(
+        compiled, mesh, 128, backend="pallas-interpret", block_b=8
+    )
+    cls_ids, lens, host_eval = encode_for_match(compiled, LINES, 128)
+    assert not host_eval.any()
+    got = backend.match_bits(cls_ids, lens)
+    for j, pat in enumerate(PATTERNS):
+        rx = re.compile(pat)
+        for i, line in enumerate(LINES):
+            assert bool(got[i, j]) == (rx.search(line) is not None), (pat, line)
+
+
+@pytest.mark.parametrize("n_lines", [1, 3, 7, 13])
+def test_sharded_backend_dp_remainder(n_lines):
+    """Batches not divisible by dp * block_b pad transparently and return
+    results in input order."""
+    from banjax_tpu.parallel.mesh import ShardedMatchBackend
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rp = 2
+    compiled = compile_rules(PATTERNS, n_shards=rp)
+    mesh = make_mesh(8, rp=rp)
+    backend = ShardedMatchBackend(
+        compiled, mesh, 128, backend="pallas-interpret", block_b=8
+    )
+    lines = LINES[:n_lines]
+    cls_ids, lens, _ = encode_for_match(compiled, lines, 128)
+    got = backend.match_bits(cls_ids, lens)
+    assert got.shape == (n_lines, compiled.n_rules)
+    for j, pat in enumerate(PATTERNS):
+        rx = re.compile(pat)
+        for i, line in enumerate(lines):
+            assert bool(got[i, j]) == (rx.search(line) is not None), (pat, line)
+
+
+def test_sharded_backend_xla_parity():
+    """XLA mesh body and Pallas mesh body agree bit-for-bit."""
+    from banjax_tpu.parallel.mesh import ShardedMatchBackend
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rp = 4
+    compiled = compile_rules(PATTERNS, n_shards=rp)
+    mesh = make_mesh(8, rp=rp)
+    cls_ids, lens, _ = encode_for_match(compiled, LINES, 128)
+    a = ShardedMatchBackend(
+        compiled, mesh, 128, backend="pallas-interpret", block_b=8
+    ).match_bits(cls_ids, lens)
+    b = ShardedMatchBackend(compiled, mesh, 128, backend="xla").match_bits(
+        cls_ids, lens
+    )
+    assert (a == b).all()
+
+
+def test_rp_mismatch_rejected():
+    """A ruleset compiled for K shards cannot ride a mesh with rp != K."""
+    from banjax_tpu.parallel.mesh import ShardedMatchBackend, sharded_pallas_fn
+    from banjax_tpu.matcher.kernels import nfa_match
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    compiled = compile_rules(PATTERNS, n_shards=2)
+    mesh = make_mesh(8, rp=4)
+    with pytest.raises(ValueError, match="shards"):
+        sharded_match_fn(compiled, mesh)
+    with pytest.raises(ValueError, match="shards"):
+        sharded_pallas_fn(nfa_match.prepare(compiled), mesh, 32, 8, 8)
+
+
+def test_mesh_tpu_matcher_consume_lines_matches_cpu_oracle():
+    """TpuMatcher in mesh mode (the config-driven product path) produces the
+    identical ConsumeLineResult stream + Banner effects as CpuMatcher."""
+    import time
+
+    from tests.mesh_oracle import assert_mesh_matches_cpu_oracle
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    yaml_text = r"""
+regexes_with_rates:
+  - decision: nginx_block
+    rule: 'rule1'
+    regex: 'GET example\.com GET .*'
+    interval: 5
+    hits_per_interval: 2
+  - decision: challenge
+    rule: 'rule2'
+    regex: 'POST .*'
+    interval: 5
+    hits_per_interval: 1
+"""
+    now = time.time()
+    lines = [
+        f"{now:.6f} 10.1.1.{i % 4} GET example.com GET /x{i} HTTP/1.1"
+        for i in range(20)
+    ] + [
+        f"{now:.6f} 10.1.1.9 POST example.com POST /submit HTTP/1.1"
+        for _ in range(4)
+    ]
+    assert_mesh_matches_cpu_oracle(yaml_text, lines, now, 8, 2, interpret=True)
+
+
+def test_mesh_long_line_near_max_len():
+    """A line at exactly matcher_max_line_len survives the L_p trim (the
+    mesh path must column-slice both sides of the copy)."""
+    import time
+
+    from tests.mesh_oracle import assert_mesh_matches_cpu_oracle
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    yaml_text = (
+        "regexes_with_rates:\n"
+        "  - decision: nginx_block\n"
+        "    rule: tail\n"
+        "    regex: 'zzz$'\n"
+        "    interval: 5\n"
+        "    hits_per_interval: 2\n"
+        "matcher_max_line_len: 100\n"
+    )
+    now = time.time()
+    rest = "GET h.com GET /" + "a" * 82 + "zzz"  # rest is exactly 100 chars
+    assert len(rest) == 100
+    lines = [f"{now:.6f} 5.6.7.8 {rest}", f"{now:.6f} 5.6.7.8 GET h.com GET /"]
+    assert_mesh_matches_cpu_oracle(yaml_text, lines, now, 8, 2, interpret=True)
+
+
+def test_mesh_more_devices_than_available_degrades():
+    """matcher_mesh_devices beyond the attached device count falls back to
+    the single-device path with a warning, not a crash."""
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from tests.mock_banner import MockBanner
+
+    cfg = config_from_yaml_text(
+        "regexes_with_rates:\n"
+        "  - decision: nginx_block\n"
+        "    rule: r\n"
+        "    regex: 'GET .*'\n"
+        "    interval: 5\n"
+        "    hits_per_interval: 2\n"
+    )
+    cfg.matcher_mesh_devices = 4096
+    m = TpuMatcher(
+        cfg, MockBanner(), StaticDecisionLists(cfg), RegexRateLimitStates()
+    )
+    assert m._mesh_matcher is None
+    r = m.consume_line(f"{__import__('time').time():.6f} 1.2.3.4 GET h.com GET /")
+    assert not r.error
