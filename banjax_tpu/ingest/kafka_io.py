@@ -13,12 +13,13 @@ Reference behavior: /root/reference/internal/kafka.go —
     banjax_tpu/ingest/reports.py) into the report topic, reconnecting with
     5 s backoff on failure.
 
-Transport: this image has no Kafka client library, so the wire transport is
-pluggable. `KafkaTransport` is the interface; `NullTransport` (default when
-no client is importable) logs-and-drops like a disconnected broker, and tests
-inject `InMemoryTransport`. If `aiokafka` is available it is used
-automatically. All reference behaviors above live OUTSIDE the transport, so
-they are fully exercised in tests regardless of the wire client.
+Transport: pluggable `KafkaTransport` interface. The default is the real
+broker client — `banjax_tpu.ingest.kafka_wire.WireKafkaTransport`, a pure-
+stdlib Kafka binary-protocol implementation (TLS/mTLS, version-negotiated,
+partition-pinned LastOffset consumer, acks=1 producer). Tests inject
+`InMemoryTransport`; `NullTransport` models a permanently-unreachable
+broker. All reference behaviors above live OUTSIDE the transport, so they
+are fully exercised in tests regardless of the wire client.
 """
 
 from __future__ import annotations
@@ -106,15 +107,9 @@ class InMemoryTransport(KafkaTransport):
 
 
 def default_transport() -> KafkaTransport:
-    try:
-        import aiokafka  # noqa: F401 — optional, absent in this image
-        from banjax_tpu.ingest.kafka_aiokafka import AiokafkaTransport  # type: ignore
+    from banjax_tpu.ingest.kafka_wire import WireKafkaTransport
 
-        return AiokafkaTransport()
-    except ImportError:
-        log.warning("KAFKA: no kafka client library available; using NullTransport "
-                    "(reader/writer will retry-and-drop)")
-        return NullTransport()
+    return WireKafkaTransport()
 
 
 # ----------------------------------------------------------- TTL selection

@@ -391,9 +391,12 @@ class TpuMatcher(Matcher):
             )
             bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
             device_rows = np.flatnonzero(~host_eval)
-            if device_rows.size:
-                bits[device_rows] = self._mesh_matcher.match_bits(
-                    cls_ids[device_rows], lens[device_rows]
+            # chunk by max_batch like the single-device path, so one huge
+            # tailer burst can't compile an outsized one-off program
+            for start in range(0, len(device_rows), self._max_batch):
+                rows = device_rows[start : start + self._max_batch]
+                bits[rows] = self._mesh_matcher.match_bits(
+                    cls_ids[rows], lens[rows]
                 )
         else:
             cls_ids, lens, host_eval = encode_for_match(

@@ -295,19 +295,21 @@ class ShardedMatchBackend:
         cls_ids = np.asarray(cls_ids, dtype=np.int32)
         lens = np.asarray(lens, dtype=np.int32)
         B, L = cls_ids.shape
+        # bucket the padded batch to power-of-two multiples of dp*block_b so
+        # varying batch sizes share a bounded set of compiled programs
         chunk = self.dp * self.block_b
-        Bp = max(chunk, -(-B // chunk) * chunk)
+        Bp = chunk
+        while Bp < B:
+            Bp <<= 1
 
         # trim the scan to the longest real line (pad columns can't change
-        # state), keeping the jitted L_p variants to a multiple of 32
+        # state); power-of-two buckets bound the jitted L_p variants
         max_len = int(lens.max()) if B else 0
-        L_p = max(
-            pallas_nfa._COLS_PER_STEP,
-            min(
-                pallas_nfa._pad_to(L, pallas_nfa._COLS_PER_STEP),
-                pallas_nfa._pad_to(max_len, 32),
-            ),
-        )
+        L_cap = pallas_nfa._pad_to(L, pallas_nfa._COLS_PER_STEP)
+        L_p = 32
+        while L_p < max_len:
+            L_p <<= 1
+        L_p = max(pallas_nfa._COLS_PER_STEP, min(L_cap, L_p))
 
         # length-sorted round-robin over dp: device d gets sorted lines
         # d, d+dp, d+2*dp, ... — balanced tile-skip work per device

@@ -1,0 +1,290 @@
+"""In-process fake Kafka broker speaking the server side of the wire
+protocol subset banjax_tpu.ingest.kafka_wire implements.
+
+Two advertised-version modes exercise both client ladders:
+  * "legacy": Metadata ≤1, ListOffsets ≤1, Fetch ≤2, Produce ≤2
+    (message-set v1 on the wire)
+  * "modern": Metadata ≤7, ListOffsets ≤4, Fetch ≤10, Produce ≤7
+    (record-batch v2 — the post-KIP-896 Kafka 4.x shape)
+
+Single node, in-memory logs, optional TLS. Requests are answered on a
+thread per connection; long-poll fetches honor max_wait_ms.
+"""
+
+from __future__ import annotations
+
+import socket
+import ssl
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from banjax_tpu.ingest.kafka_wire import (
+    _Reader,
+    _decode_record_batches,
+    _encode_message_set_v1,
+    _encode_record_batch_v2,
+    _string,
+)
+
+_MODES = {
+    "legacy": {0: (0, 2), 1: (0, 2), 2: (0, 1), 3: (0, 1), 18: (0, 0)},
+    "modern": {0: (3, 7), 1: (4, 10), 2: (2, 4), 3: (4, 7), 18: (0, 0)},
+}
+
+
+class FakeKafkaBroker:
+    def __init__(self, mode: str = "modern", n_partitions: int = 1,
+                 ssl_context: Optional[ssl.SSLContext] = None):
+        self.mode = mode
+        self.versions = _MODES[mode]
+        self.n_partitions = n_partitions
+        self.logs: Dict[Tuple[str, int], List[bytes]] = {}
+        self._lock = threading.Lock()
+        self._data_event = threading.Condition(self._lock)
+        self._ssl_context = ssl_context
+        self._server = socket.create_server(("127.0.0.1", 0))
+        self.port = self._server.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.produce_count = 0
+
+    # -- lifecycle
+
+    def start(self) -> "FakeKafkaBroker":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+    def append(self, topic: str, partition: int, value: bytes) -> None:
+        """Seed a message directly (as if another producer wrote it)."""
+        with self._data_event:
+            self.logs.setdefault((topic, partition), []).append(value)
+            self._data_event.notify_all()
+
+    def log_end_offset(self, topic: str, partition: int) -> int:
+        with self._lock:
+            return len(self.logs.get((topic, partition), []))
+
+    # -- server loop
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            if self._ssl_context is not None:
+                try:
+                    conn = self._ssl_context.wrap_socket(conn, server_side=True)
+                except ssl.SSLError:
+                    conn.close()
+                    continue
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                head = self._read_exact(conn, 4)
+                if head is None:
+                    return
+                (size,) = struct.unpack(">i", head)
+                payload = self._read_exact(conn, size)
+                if payload is None:
+                    return
+                r = _Reader(payload)
+                api_key, version, corr = r.i16(), r.i16(), r.i32()
+                r.string()  # client_id
+                body = self._dispatch(api_key, version, r)
+                conn.sendall(
+                    struct.pack(">i", len(body) + 4)
+                    + struct.pack(">i", corr) + body
+                )
+        except (OSError, ValueError, ssl.SSLError):
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_exact(conn, n: int) -> Optional[bytes]:
+        buf = bytearray()
+        while len(buf) < n:
+            try:
+                chunk = conn.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        return bytes(buf)
+
+    # -- request handlers
+
+    def _dispatch(self, api_key: int, version: int, r: _Reader) -> bytes:
+        if api_key == 18:
+            return self._api_versions()
+        vmin, vmax = self.versions.get(api_key, (-1, -1))
+        assert vmin <= version <= vmax, (
+            f"client used api {api_key} v{version}, broker advertises "
+            f"[{vmin},{vmax}]"
+        )
+        if api_key == 3:
+            return self._metadata(version, r)
+        if api_key == 2:
+            return self._list_offsets(version, r)
+        if api_key == 1:
+            return self._fetch(version, r)
+        if api_key == 0:
+            return self._produce(version, r)
+        raise ValueError(f"unsupported api {api_key}")
+
+    def _api_versions(self) -> bytes:
+        out = struct.pack(">h", 0) + struct.pack(">i", len(self.versions))
+        for key, (vmin, vmax) in sorted(self.versions.items()):
+            out += struct.pack(">hhh", key, vmin, vmax)
+        return out
+
+    def _metadata(self, v: int, r: _Reader) -> bytes:
+        n_topics = r.i32()
+        topics = [r.string() for _ in range(n_topics)]
+        out = b""
+        if v >= 3:
+            out += struct.pack(">i", 0)  # throttle
+        out += struct.pack(">i", 1)  # one broker
+        out += struct.pack(">i", 0) + _string("127.0.0.1") + struct.pack(">i", self.port)
+        if v >= 1:
+            out += _string(None)  # rack
+        if v >= 2:
+            out += _string("fake-cluster")
+        out += struct.pack(">i", 0)  # controller_id
+        out += struct.pack(">i", len(topics))
+        for t in topics:
+            out += struct.pack(">h", 0) + _string(t)
+            if v >= 1:
+                out += struct.pack(">b", 0)  # is_internal
+            out += struct.pack(">i", self.n_partitions)
+            for pid in range(self.n_partitions):
+                out += struct.pack(">hii", 0, pid, 0)  # err, partition, leader
+                if v >= 7:
+                    out += struct.pack(">i", 0)  # leader_epoch
+                out += struct.pack(">ii", 1, 0)  # replicas [0]
+                out += struct.pack(">ii", 1, 0)  # isr [0]
+                if v >= 5:
+                    out += struct.pack(">i", 0)  # offline_replicas
+        return out
+
+    def _list_offsets(self, v: int, r: _Reader) -> bytes:
+        r.i32()  # replica_id
+        if v >= 2:
+            r.i8()  # isolation_level
+        r.i32()  # n topics (assume 1)
+        topic = r.string()
+        r.i32()  # n partitions (assume 1)
+        partition = r.i32()
+        if v >= 4:
+            r.i32()  # leader_epoch
+        r.i64()  # timestamp
+        offset = self.log_end_offset(topic, partition)
+        out = b""
+        if v >= 2:
+            out += struct.pack(">i", 0)  # throttle
+        out += struct.pack(">i", 1) + _string(topic) + struct.pack(">i", 1)
+        out += struct.pack(">ih", partition, 0)
+        out += struct.pack(">qq", -1, offset)  # timestamp, offset
+        if v >= 4:
+            out += struct.pack(">i", 0)  # leader_epoch
+        return out
+
+    def _fetch(self, v: int, r: _Reader) -> bytes:
+        r.i32()  # replica_id
+        max_wait = r.i32()
+        r.i32()  # min_bytes
+        if v >= 3:
+            r.i32()  # max_bytes
+        if v >= 4:
+            r.i8()
+        if v >= 7:
+            r.i32()
+            r.i32()
+        r.i32()  # n topics (assume 1)
+        topic = r.string()
+        r.i32()
+        partition = r.i32()
+        if v >= 9:
+            r.i32()
+        offset = r.i64()
+        if v >= 5:
+            r.i64()
+        r.i32()  # partition max bytes
+
+        deadline = time.time() + max_wait / 1000.0
+        with self._data_event:
+            while (
+                len(self.logs.get((topic, partition), [])) <= offset
+                and time.time() < deadline
+                and not self._stop.is_set()
+            ):
+                self._data_event.wait(timeout=max(0.01, deadline - time.time()))
+            msgs = list(self.logs.get((topic, partition), []))[offset:]
+
+        if v >= 3:  # modern ladder stores record batches
+            record_data = b"".join(
+                _encode_record_batch_v2(m, 0, offset + i)
+                for i, m in enumerate(msgs)
+            )
+        else:
+            record_data = b"".join(
+                _encode_message_set_v1(m, 0, offset + i)
+                for i, m in enumerate(msgs)
+            )
+        out = struct.pack(">i", 0)  # throttle
+        if v >= 7:
+            out += struct.pack(">hi", 0, 0)  # error, session_id
+        out += struct.pack(">i", 1) + _string(topic) + struct.pack(">i", 1)
+        hw = self.log_end_offset(topic, partition)
+        out += struct.pack(">ihq", partition, 0, hw)
+        if v >= 4:
+            out += struct.pack(">q", hw)  # last_stable_offset
+            if v >= 5:
+                out += struct.pack(">q", 0)  # log_start_offset
+            out += struct.pack(">i", 0)  # aborted txns
+        out += struct.pack(">i", len(record_data)) + record_data
+        return out
+
+    def _produce(self, v: int, r: _Reader) -> bytes:
+        if v >= 3:
+            r.string()  # transactional_id
+        r.i16()  # acks
+        r.i32()  # timeout
+        r.i32()  # n topics (assume 1)
+        topic = r.string()
+        r.i32()
+        partition = r.i32()
+        record_set = r.bytes_() or b""
+        values = [val for _, val in _decode_record_batches(record_set)]
+        base = self.log_end_offset(topic, partition)
+        with self._data_event:
+            log = self.logs.setdefault((topic, partition), [])
+            log.extend(values)
+            self.produce_count += len(values)
+            self._data_event.notify_all()
+        out = struct.pack(">i", 1) + _string(topic) + struct.pack(">i", 1)
+        out += struct.pack(">ihq", partition, 0, base)
+        if v >= 2:
+            out += struct.pack(">q", -1)  # log_append_time
+        if v >= 5:
+            out += struct.pack(">q", 0)  # log_start_offset
+        if v >= 1:
+            out += struct.pack(">i", 0)  # throttle
+        return out

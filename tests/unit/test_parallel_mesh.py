@@ -230,3 +230,24 @@ def test_mesh_more_devices_than_available_degrades():
     assert m._mesh_matcher is None
     r = m.consume_line(f"{__import__('time').time():.6f} 1.2.3.4 GET h.com GET /")
     assert not r.error
+
+
+def test_sharded_backend_bounded_compile_cache():
+    """Varying batch sizes and line lengths share power-of-two buckets, so
+    the per-(Bp, L_p) jit cache stays bounded in the hot path."""
+    from banjax_tpu.parallel.mesh import ShardedMatchBackend
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rp = 2
+    compiled = compile_rules(PATTERNS, n_shards=rp)
+    mesh = make_mesh(8, rp=rp)
+    backend = ShardedMatchBackend(
+        compiled, mesh, 128, backend="pallas-interpret", block_b=8
+    )
+    for n in (1, 3, 9, 17, 25, 31, 32):
+        lines = LINES[:n]
+        cls_ids, lens, _ = encode_for_match(compiled, lines, 128)
+        out = backend.match_bits(cls_ids, lens)
+        assert out.shape == (n, compiled.n_rules)
+    assert len(backend._fns) == 1  # all bucket to (32, 64)
