@@ -119,6 +119,7 @@ class BanjaxApp:
         self.metrics = MetricsReporter(
             metrics_path, self.dynamic_lists, RegexStatesView(self),
             self.failed_challenge_states,
+            matcher_getter=lambda: self._matcher,
         )
 
         gin_log_name = "gin.log" if config.standalone_testing else config.gin_log_file

@@ -354,7 +354,87 @@ def build_app(deps: ServerDeps) -> web.Application:
             return web.Response(text="")
         app.router.add_get("/favicon.ico", favicon)
 
+    if config0.profile:
+        _register_profile_routes(app)
+
     return app
+
+
+def _register_profile_routes(app: web.Application) -> None:
+    """pprof-equivalent endpoints, registered when `profile: true`
+    (reference: gin pprof + mutex profiling, http_server.go:314-317).
+
+    /debug/pprof/profile?seconds=N   cProfile of the event-loop thread
+    /debug/pprof/threads             stack dump of every thread
+    /debug/jax/trace?seconds=N       jax.profiler trace (XLA/TPU timeline),
+                                     returns the trace directory path
+    """
+    import asyncio
+    import cProfile
+    import io
+    import pstats
+    import sys
+    import tempfile
+    import traceback
+
+    profiling = {"active": False}
+
+    async def pprof_profile(request: web.Request) -> web.Response:
+        seconds = min(float(request.query.get("seconds", "5")), 60.0)
+        if profiling["active"]:
+            return web.Response(status=409, text="profile already running\n")
+        profiling["active"] = True
+        prof = cProfile.Profile()
+        prof.enable()
+        try:
+            # a client disconnect cancels the handler mid-sleep; disable in
+            # finally or cProfile stays latched on the event-loop thread
+            await asyncio.sleep(seconds)
+        finally:
+            prof.disable()
+            profiling["active"] = False
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(60)
+        return web.Response(text=buf.getvalue())
+
+    async def pprof_threads(request: web.Request) -> web.Response:
+        buf = io.StringIO()
+        frames = sys._current_frames()
+        import threading as _threading
+
+        names = {t.ident: t.name for t in _threading.enumerate()}
+        for ident, frame in frames.items():
+            buf.write(f"--- thread {names.get(ident, '?')} ({ident}) ---\n")
+            traceback.print_stack(frame, file=buf)
+            buf.write("\n")
+        return web.Response(text=buf.getvalue())
+
+    async def jax_trace(request: web.Request) -> web.Response:
+        seconds = min(float(request.query.get("seconds", "3")), 60.0)
+        try:
+            import jax
+        except ImportError:
+            return web.Response(status=501, text="jax unavailable\n")
+        if profiling["active"]:
+            return web.Response(status=409, text="profile already running\n")
+        profiling["active"] = True
+        trace_dir = tempfile.mkdtemp(prefix="banjax-jax-trace-")
+        jax.profiler.start_trace(trace_dir)
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                profiling["active"] = False
+        return web.json_response({
+            "trace_dir": trace_dir,
+            "hint": "open with xprof / tensorboard --logdir",
+        })
+
+    app.router.add_get("/debug/pprof/profile", pprof_profile)
+    app.router.add_get("/debug/pprof/threads", pprof_threads)
+    app.router.add_get("/debug/jax/trace", jax_trace)
 
 
 async def run_http_server(deps: ServerDeps) -> web.AppRunner:

@@ -18,11 +18,14 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 from typing import List, Optional
 
 from banjax_tpu.decisions.rate_limit import RateLimitResult
 
 _log = logging.getLogger(__name__)
+
+_stats_init_lock = threading.Lock()
 
 
 @dataclasses.dataclass
@@ -49,6 +52,21 @@ class ConsumeLineResult:
 class Matcher:
     """One log line in, one ConsumeLineResult out (plus Banner side effects)."""
 
+    @property
+    def stats(self):
+        """Runtime counters surfaced in the 29s metrics line (obs/stats.py).
+        Creation is lock-guarded: the metrics thread and the tailer thread
+        can both hit a fresh matcher concurrently."""
+        s = getattr(self, "_stats", None)
+        if s is None:
+            with _stats_init_lock:
+                s = getattr(self, "_stats", None)
+                if s is None:
+                    from banjax_tpu.obs.stats import MatcherStats
+
+                    s = self._stats = MatcherStats()
+        return s
+
     def consume_line(self, line_text: str, now_unix: Optional[float] = None) -> ConsumeLineResult:
         raise NotImplementedError
 
@@ -58,6 +76,9 @@ class Matcher:
         """Batch entry point. The TPU matcher overrides this with one device
         pass per batch; the default preserves the serial reference semantics,
         including per-line fault isolation (one bad line loses only itself)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         results = []
         for line in lines:
             try:
@@ -65,6 +86,7 @@ class Matcher:
             except Exception:  # noqa: BLE001 — isolate faults per line
                 _log.exception("error consuming log line")
                 results.append(ConsumeLineResult(error=True))
+        self.stats.record_batch(len(lines), _time.perf_counter() - t0)
         return results
 
     def close(self) -> None:

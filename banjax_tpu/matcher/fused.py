@@ -1,0 +1,117 @@
+"""Fused UA + path matching on device (SURVEY.md C7 TPU plan;
+BASELINE.json configs[3]).
+
+The reference checks User-Agent patterns serially per request in severity
+order (/root/reference/internal/user_agent_decision.go:55-64) and path/rate
+rules serially per log line (regex_rate_limiter.go:216-269). On TPU both
+ ruleset kinds compile into ONE batched NFA: UA patterns (regexes as-is,
+substring patterns as escaped literals) occupy columns after the rate
+rules, so a single kernel pass over a line batch yields both the rate-rule
+bitmap and the UA bitmap — `DeviceUAMatcher` then reduces the UA columns to
+the reference's first-match-in-severity-order decision.
+
+Substring-vs-regex auto-detection follows ua_lists.contains_regex_metachar
+exactly, so device results are differentially testable against
+check_ua_decision (tests/unit/test_fused.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.decisions.ua_lists import _UA_CHECK_ORDER, UARules
+from banjax_tpu.matcher import nfa_jax
+from banjax_tpu.matcher.encode import encode_for_match
+from banjax_tpu.matcher.kernels import nfa_match as pallas_nfa
+from banjax_tpu.matcher.rulec import compile_rules
+
+
+def ua_patterns_in_severity_order(rules: UARules) -> List[Tuple[Decision, str]]:
+    """Flatten a UARules map into (decision, regex_string) rows in the exact
+    order check_ua_decision scans them; substring patterns are escaped."""
+    out: List[Tuple[Decision, str]] = []
+    for d in _UA_CHECK_ORDER:
+        for p in rules.get(d, ()):
+            out.append((d, p.raw if p.compiled is not None else re.escape(p.raw)))
+    return out
+
+
+class DeviceUAMatcher:
+    """Batched UA classification: one NFA pass, then severity-order argmax."""
+
+    def __init__(self, rules: UARules, max_len: int = 256,
+                 backend: str = "xla", extra_rules: Sequence[str] = ()):
+        """`extra_rules` are regex strings (e.g. the rate rules) fused into
+        the same compiled ruleset; their match bits come back separately
+        from match_bits()."""
+        self._rows = ua_patterns_in_severity_order(rules)
+        self.n_extra = len(extra_rules)
+        patterns = list(extra_rules) + [rx for _, rx in self._rows]
+        self.compiled = compile_rules(patterns, n_shards="auto")
+        self._decisions = [d for d, _ in self._rows]
+        self.max_len = max_len
+        self.backend = backend
+        self._params = None
+        self._prep = None
+        if backend in ("pallas", "pallas-interpret"):
+            self._prep = pallas_nfa.prepare(self.compiled)
+        else:
+            self._params = nfa_jax.match_params(self.compiled)
+        # host fallback for rules the compiler can't lower or non-ASCII lines
+        self._host_rx = [re.compile(p) for p in patterns]
+        self._host_rule_idx = [
+            i for i in range(len(patterns)) if not self.compiled.device_ok[i]
+        ]
+
+    def match_bits(self, lines: Sequence[str]) -> np.ndarray:
+        """[B, n_extra + n_ua_patterns] uint8 — the fused bitmap."""
+        cls_ids, lens, host_eval = encode_for_match(
+            self.compiled, lines, self.max_len
+        )
+        n = len(lines)
+        bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
+        rows = np.flatnonzero(~host_eval)
+        if rows.size:
+            if self._prep is not None:
+                bits[rows] = pallas_nfa.match_batch_pallas(
+                    self._prep, cls_ids[rows], lens[rows],
+                    interpret=self.backend == "pallas-interpret",
+                )
+            else:
+                bits[rows] = np.asarray(
+                    nfa_jax.match_batch(
+                        self._params, cls_ids[rows], lens[rows],
+                        self.compiled.n_rules,
+                    )
+                )
+        for row in np.flatnonzero(host_eval):
+            for i, rx in enumerate(self._host_rx):
+                if rx.search(lines[row]) is not None:
+                    bits[row, i] = 1
+        for i in self._host_rule_idx:
+            rx = self._host_rx[i]
+            for row in rows:
+                if rx.search(lines[row]) is not None:
+                    bits[row, i] = 1
+        return bits
+
+    def decide(self, ua_bits: np.ndarray) -> List[Tuple[Optional[Decision], bool]]:
+        """Reduce UA columns (bitmap WITHOUT the extra-rule columns) to the
+        reference's first-match-in-severity-order result per row."""
+        out: List[Tuple[Optional[Decision], bool]] = []
+        for row in ua_bits:
+            hit = np.flatnonzero(row)
+            if hit.size:
+                out.append((self._decisions[int(hit[0])], True))
+            else:
+                out.append((None, False))
+        return out
+
+    def check_batch(self, user_agents: Sequence[str]) -> List[Tuple[Optional[Decision], bool]]:
+        """Batched check_ua_decision (identical results, one device pass)."""
+        bits = self.match_bits(user_agents)
+        return self.decide(bits[:, self.n_extra :])

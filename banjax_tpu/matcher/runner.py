@@ -257,6 +257,15 @@ class TpuMatcher(Matcher):
     def consume_lines(
         self, lines: Sequence[str], now_unix: Optional[float] = None
     ) -> List[ConsumeLineResult]:
+        t0 = time.perf_counter()
+        try:
+            return self._consume_lines_inner(lines, now_unix)
+        finally:
+            self.stats.record_batch(len(lines), time.perf_counter() - t0)
+
+    def _consume_lines_inner(
+        self, lines: Sequence[str], now_unix: Optional[float] = None
+    ) -> List[ConsumeLineResult]:
         now = time.time() if now_unix is None else now_unix
         results = [ConsumeLineResult() for _ in lines]
 

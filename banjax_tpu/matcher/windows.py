@@ -384,6 +384,12 @@ class DeviceWindows:
                 else:
                     self._pin_counts.pop(slot, None)
 
+    @property
+    def occupancy(self) -> int:
+        """IP slots currently assigned (capacity-pressure gauge)."""
+        with self._lock:
+            return len(self._slots)
+
     def clear(self) -> None:
         """Hot-reload semantics: drop all counters (decision.go Clear analog)."""
         with self._lock:

@@ -5,9 +5,10 @@ every 29 s write one JSON object {Time, LenExpiringChallenges,
 LenExpiringBlocks, LenIpToRegexStates, LenFailedChallengeStates} to
 metrics_log_file (or `list-metrics.log` in standalone testing).
 
-The TPU matcher additionally exposes counters (lines/sec, batch latency)
-through its own stats hook; those are reported by bench.py rather than here
-to keep this line's schema identical to the reference.
+The reference's five keys keep their exact names and meaning; the TPU
+subsystem's production counters (matcher lines/sec, batch latency p50/p99,
+device-windows occupancy/evictions — obs/stats.py) are ADDITIVE keys on the
+same line, present when a matcher is wired in.
 """
 
 from __future__ import annotations
@@ -15,7 +16,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Optional, TextIO
+from typing import Callable, Optional, TextIO
 
 from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
 from banjax_tpu.decisions.rate_limit import (
@@ -31,6 +32,7 @@ def write_metrics_line(
     dynamic_lists: DynamicDecisionLists,
     regex_states: RegexRateLimitStates,
     failed_challenge_states: FailedChallengeRateLimitStates,
+    matcher=None,
 ) -> None:
     challenges, blocks = dynamic_lists.metrics()
     line = {
@@ -40,6 +42,10 @@ def write_metrics_line(
         "LenIpToRegexStates": len(regex_states),
         "LenFailedChallengeStates": len(failed_challenge_states),
     }
+    if matcher is not None:
+        line.update(
+            matcher.stats.snapshot(getattr(matcher, "device_windows", None))
+        )
     out.write(json.dumps(line) + "\n")
     out.flush()
 
@@ -52,12 +58,15 @@ class MetricsReporter:
         regex_states: RegexRateLimitStates,
         failed_challenge_states: FailedChallengeRateLimitStates,
         interval_seconds: float = REPORT_INTERVAL_SECONDS,
+        matcher_getter: Optional[Callable[[], object]] = None,
     ):
         self.log_path = log_path
         self.dynamic_lists = dynamic_lists
         self.regex_states = regex_states
         self.failed_challenge_states = failed_challenge_states
         self.interval_seconds = interval_seconds
+        # a getter, not the matcher itself: SIGHUP reload swaps the matcher
+        self.matcher_getter = matcher_getter
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -73,6 +82,8 @@ class MetricsReporter:
     def _run(self) -> None:
         with open(self.log_path, "w", encoding="utf-8") as out:
             while not self._stop.wait(self.interval_seconds):
+                matcher = self.matcher_getter() if self.matcher_getter else None
                 write_metrics_line(
-                    out, self.dynamic_lists, self.regex_states, self.failed_challenge_states
+                    out, self.dynamic_lists, self.regex_states,
+                    self.failed_challenge_states, matcher,
                 )

@@ -1,0 +1,15 @@
+#!/bin/sh
+# banjax-tpu container entrypoint.
+#
+# BANJAX_CONFIG       config file path (default /etc/banjax/banjax-config.yaml)
+# BANJAX_DEBUG=1      verbose per-line/per-request logging
+# BANJAX_STANDALONE=1 standalone-testing mode (no nginx: fake the X-* headers,
+#                     self-write the access log, skip ipset)
+set -e
+
+CONFIG="${BANJAX_CONFIG:-/etc/banjax/banjax-config.yaml}"
+ARGS="-config-file $CONFIG"
+[ -n "$BANJAX_DEBUG" ] && ARGS="$ARGS -debug"
+[ -n "$BANJAX_STANDALONE" ] && ARGS="$ARGS -standalone-testing"
+
+exec python -m banjax_tpu.cli $ARGS

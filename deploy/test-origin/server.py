@@ -1,0 +1,40 @@
+"""Fake upstream origin for the compose harness: echoes the requested URL.
+
+Equivalent of the reference's hello-world test origin
+(/root/reference/supporting-containers/test-origin/hello-world.go:15-33):
+/hello says hello, everything else gets a 404 page naming the requested
+path, so end-to-end tests can assert which URL reached the origin.
+"""
+
+import datetime
+
+from aiohttp import web
+
+
+async def hello(request: web.Request) -> web.Response:
+    return web.Response(text="hello!\n")
+
+
+async def catch_all(request: web.Request) -> web.Response:
+    now = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
+    page = (
+        "<html><head><title>banjax-tpu test-origin</title>"
+        "<style>body{padding:2em;background-color:#ecece2;}</style></head>"
+        f"<body><h1>Requested URL: {request.path}</h1>"
+        f"banjax-tpu test-origin @ {now} UTC+0</body></html>"
+    )
+    return web.Response(
+        status=404, text=page, content_type="text/html",
+        headers={"Cache-Control": "no-cache"},
+    )
+
+
+def make_app() -> web.Application:
+    app = web.Application()
+    app.router.add_get("/hello", hello)
+    app.router.add_route("*", "/{tail:.*}", catch_all)
+    return app
+
+
+if __name__ == "__main__":
+    web.run_app(make_app(), host="0.0.0.0", port=8080)

@@ -1,0 +1,97 @@
+"""Deployment-shell contract tests (no docker needed).
+
+The compose harness can only run where docker exists; these tests lock the
+parts of deploy/ that the product code depends on: the banjax_format log
+line nginx writes must parse into exactly the fields the tailer/matcher
+expect, and the shipped container config must load and build a working
+matcher."""
+
+import re
+import time
+from pathlib import Path
+
+from banjax_tpu.config.holder import ConfigHolder
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from banjax_tpu.matcher.encode import parse_line
+from tests.mock_banner import MockBanner
+
+DEPLOY = Path(__file__).resolve().parents[2] / "deploy"
+
+
+def test_nginx_conf_carries_the_tailer_log_format():
+    conf = (DEPLOY / "nginx" / "nginx.conf").read_text()
+    want = (
+        "log_format banjax_format '$msec $remote_addr $request_method "
+        "$host $request_method $uri $server_protocol $http_user_agent "
+        "| $status';"
+    )
+    assert want in conf
+    # the auth subrequest contract: all four X-* headers + body off + target
+    for needle in (
+        "proxy_set_header X-Requested-Host $host;",
+        "proxy_set_header X-Client-IP $remote_addr;",
+        "proxy_set_header X-Requested-Path $request_uri;",
+        "proxy_set_header X-Client-User-Agent $http_user_agent;",
+        "proxy_pass_request_body off;",
+        "proxy_pass http://127.0.0.1:8081/auth_request?;",
+        "location @access_granted",
+        "location @access_denied",
+        "location @fail_open",
+        "location @fail_closed",
+    ):
+        assert needle in conf, needle
+
+
+def test_banjax_format_line_parses_and_matches():
+    """A line exactly as nginx banjax_format renders it goes through
+    parse_line and trips the deploy config's demo challenge rule."""
+    now = time.time()
+    line = (
+        f"{now:.3f} 203.0.113.7 GET localhost GET /challengeme HTTP/1.1 "
+        "Mozilla/5.0 (X11; Linux x86_64) | 404"
+    )
+    p = parse_line(line, now)
+    assert not p.error and not p.old_line
+    assert p.ip == "203.0.113.7"
+    assert p.host == "localhost"
+    assert p.rest.startswith("GET localhost GET /challengeme")
+
+    holder = ConfigHolder(
+        str(DEPLOY / "banjax-config.yaml"), standalone_testing=True, debug=False
+    )
+    cfg = holder.get()
+    matcher = CpuMatcher(
+        cfg, MockBanner(), StaticDecisionLists(cfg), RegexRateLimitStates()
+    )
+    result = matcher.consume_line(line, now)
+    hits = [r for r in result.rule_results if r.regex_match]
+    assert any(r.rule_name == "instant challenge (demo)" for r in hits)
+    # hits_per_interval 0 → first hit exceeds → Banner fired
+    assert any(
+        r.rate_limit_result is not None and r.rate_limit_result.exceeded
+        for r in hits
+    )
+
+
+def test_deploy_config_loads_with_validation():
+    holder = ConfigHolder(
+        str(DEPLOY / "banjax-config.yaml"), standalone_testing=False, debug=False
+    )
+    cfg = holder.get()
+    assert cfg.matcher == "tpu"
+    assert cfg.server_log_file == "/var/log/banjax/banjax-format.log"
+    assert cfg.password_hashes.get("localhost") == (
+        "5e884898da28047151d0e56f8dc6292773603d0d6aabbdd62a11ef721d1542d8"
+    )
+
+
+def test_compose_and_entrypoint_shape():
+    compose = (DEPLOY / "docker-compose.yml").read_text()
+    assert 'network_mode: "service:nginx"' in compose  # iptables in the right netns
+    assert "NET_ADMIN" in compose
+    for svc in ("banjax-tpu:", "nginx:", "test-origin:"):
+        assert svc in compose
+    entry = (DEPLOY / "entrypoint.sh").read_text()
+    assert "python -m banjax_tpu.cli" in entry

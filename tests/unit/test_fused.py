@@ -1,0 +1,68 @@
+"""Fused UA+path matcher vs the serial reference semantics."""
+
+import numpy as np
+import pytest
+
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.decisions.ua_lists import build_ua_rules, check_ua_decision
+from banjax_tpu.matcher.fused import DeviceUAMatcher, ua_patterns_in_severity_order
+
+RAW = {
+    "allow": ["GoodBot", "curl/[78]"],
+    "challenge": ["Mozilla/4", "scanner"],
+    "nginx_block": [r"sqlmap|nikto", "BadBot/2.0"],
+    "iptables_block": ["EvilBot"],
+}
+
+UAS = [
+    "Mozilla/5.0 (X11; Linux x86_64)",
+    "Mozilla/4.0 (compatible; MSIE 6.0)",
+    "sqlmap/1.7-dev",
+    "EvilBot scanner",          # iptables beats challenge (severity order)
+    "GoodBot scanner",          # allow is checked LAST: challenge wins
+    "curl/8.1.2",
+    "BadBot/2.0 (+http://x)",
+    "",
+    "nothing notable",
+]
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas-interpret"])
+def test_device_ua_matches_serial_reference(backend):
+    rules = build_ua_rules(RAW)
+    dm = DeviceUAMatcher(rules, backend=backend)
+    got = dm.check_batch(UAS)
+    want = [check_ua_decision(rules, ua) for ua in UAS]
+    assert got == want
+
+
+def test_severity_order_flattening():
+    rules = build_ua_rules(RAW)
+    rows = ua_patterns_in_severity_order(rules)
+    decisions = [d for d, _ in rows]
+    assert decisions == sorted(decisions, reverse=True)  # severity descending
+    # substring patterns are escaped ("BadBot/2.0" has a metachar-free dot? no:
+    # '.' IS a metachar, so it stays a regex; "EvilBot" is a substring → escaped
+    flat = dict((rx, d) for d, rx in rows)
+    assert "EvilBot" in flat  # re.escape("EvilBot") == "EvilBot"
+
+
+def test_fused_extra_rules_share_the_pass():
+    """Rate rules and UA patterns coexist in one compiled ruleset: columns
+    [0, n_extra) are the rate rules, the rest the UA patterns."""
+    rules = build_ua_rules(RAW)
+    dm = DeviceUAMatcher(
+        rules, backend="xla",
+        extra_rules=[r"GET /wp-login\.php", r"POST /xmlrpc\.php"],
+    )
+    lines = [
+        "GET example.com GET /wp-login.php HTTP/1.1 sqlmap/1.7",
+        "POST example.com POST /xmlrpc.php HTTP/1.1 Mozilla/5.0",
+        "GET example.com GET / HTTP/1.1 GoodBot",
+    ]
+    bits = dm.match_bits(lines)
+    assert bits.shape[1] == 2 + sum(len(v) for v in RAW.values())
+    assert bits[0, 0] == 1 and bits[1, 1] == 1 and not bits[2, :2].any()
+    ua_decisions = dm.decide(bits[:, 2:])
+    assert ua_decisions[0] == (Decision.NGINX_BLOCK, True)   # sqlmap
+    assert ua_decisions[2] == (Decision.ALLOW, True)         # GoodBot
