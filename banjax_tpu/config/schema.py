@@ -167,6 +167,9 @@ class Config:
     # (widest power of two ≤ min(4, devices) that divides the device count).
     matcher_mesh_devices: int = 0
     matcher_mesh_rp: int = 0
+    # native C batch parse+encode for the tailer hot path (banjax_tpu/
+    # native); auto-disables when no C compiler is present
+    matcher_native_parse: bool = True
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -198,6 +201,7 @@ _SCALAR_KEYS = {
     "matcher_backend": str, "matcher_device_windows": bool,
     "matcher_window_capacity": int, "matcher_prefilter": bool,
     "matcher_mesh_devices": int, "matcher_mesh_rp": int,
+    "matcher_native_parse": bool,
 }
 
 _DICT_OR_LIST_KEYS = {

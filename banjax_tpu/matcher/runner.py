@@ -117,6 +117,16 @@ class TpuMatcher(Matcher):
         self._max_len = config.matcher_max_line_len
         self._max_batch = max(_MIN_BUCKET, config.matcher_batch_lines)
 
+        # native C batch parse+encode (banjax_tpu/native): ~16x the Python
+        # per-line parse loop; per-line semantics identical (defer contract)
+        self._native = False
+        if getattr(config, "matcher_native_parse", True):
+            from banjax_tpu import native as _native
+
+            self._native = _native.available()
+            if not self._native:
+                log.info("native fastparse unavailable; Python parse path")
+
         # device backend: the Pallas kernel where it pays (TPU), the XLA
         # scan elsewhere; "pallas-interpret" is the CI path
         backend = getattr(config, "matcher_backend", "auto") or "auto"
@@ -270,25 +280,89 @@ class TpuMatcher(Matcher):
         results = [ConsumeLineResult() for _ in lines]
 
         # 1. host parse + allowlist exemption (regex_rate_limiter.go:131-172)
+        #    — one native C pass when available (banjax_tpu/native), with
+        #    the Python reference path per deferred line and as fallback
         work: List[Tuple[int, ParsedLine]] = []
-        for i, text in enumerate(lines):
-            p = parse_line(text, now, OLD_LINE_CUTOFF_SECONDS)
-            if p.error:
-                log.warning("could not parse log line: %r", text)
-                results[i].error = True
-                continue
-            if p.old_line:
-                results[i].old_line = True
-                continue
-            if self.decision_lists.check_is_allowed(p.host, p.ip):
-                results[i].exempted = True
-                continue
-            work.append((i, p))
+        pre_encoded = None
+        nb = None
+        if self._native:
+            from banjax_tpu import native
+
+            nb = native.parse_encode_batch(
+                lines, self.compiled.byte_to_class, self._max_len, now,
+                OLD_LINE_CUTOFF_SECONDS,
+            )
+        if nb is not None:
+            from banjax_tpu import native
+
+            work_rows: List[int] = []
+            for i in range(nb.n):
+                f = int(nb.flags[i])
+                if f & native.FLAG_DEFER:
+                    p = parse_line(lines[i], now, OLD_LINE_CUTOFF_SECONDS)
+                elif f & native.FLAG_ERROR:
+                    p = ParsedLine(error=True)
+                else:
+                    p = ParsedLine(
+                        old_line=bool(f & native.FLAG_OLD),
+                        timestamp_ns=int(nb.ts_ns[i]),
+                        ip=nb.ip(i),
+                    )
+                    if not p.old_line:
+                        p.host = nb.host(i)
+                        p.rest = nb.rest(i)
+                if p.error:
+                    log.warning("could not parse log line: %r", lines[i])
+                    results[i].error = True
+                    continue
+                if p.old_line:
+                    results[i].old_line = True
+                    continue
+                if self.decision_lists.check_is_allowed(p.host, p.ip):
+                    results[i].exempted = True
+                    continue
+                work.append((i, p))
+                work_rows.append(i)
+            if work:
+                rows = np.asarray(work_rows)
+                deferred = (np.asarray(nb.flags)[rows] & native.FLAG_DEFER) != 0
+                cls_ids = nb.cls_ids[rows]
+                lens = nb.lens[rows]
+                host_eval = (
+                    (np.asarray(nb.flags)[rows] & native.FLAG_HOST_EVAL) != 0
+                )
+                if deferred.any():
+                    # deferred rows were Python-parsed: encode them the
+                    # Python way into the same arrays
+                    d_idx = np.flatnonzero(deferred)
+                    d_cls, d_lens, d_he = encode_for_match(
+                        self.compiled,
+                        [work[int(k)][1].rest for k in d_idx],
+                        self._max_len,
+                    )
+                    cls_ids[d_idx] = d_cls
+                    lens[d_idx] = d_lens
+                    host_eval[d_idx] = d_he
+                pre_encoded = (cls_ids, lens, host_eval)
+        else:
+            for i, text in enumerate(lines):
+                p = parse_line(text, now, OLD_LINE_CUTOFF_SECONDS)
+                if p.error:
+                    log.warning("could not parse log line: %r", text)
+                    results[i].error = True
+                    continue
+                if p.old_line:
+                    results[i].old_line = True
+                    continue
+                if self.decision_lists.check_is_allowed(p.host, p.ip):
+                    results[i].exempted = True
+                    continue
+                work.append((i, p))
         if not work:
             return results
 
         # 2. device match bitmap for all matchable lines
-        bits = self._match_bits([p for _, p in work])
+        bits = self._match_bits([p for _, p in work], pre_encoded)
 
         # 3a. device window pass: fold the whole batch of match events into
         #     the persistent on-device counters in one step, then replay the
@@ -386,8 +460,14 @@ class TpuMatcher(Matcher):
 
     # ---- internals ----
 
-    def _match_bits(self, parsed: List[ParsedLine]) -> np.ndarray:
-        """[N, n_rules] uint8 — exact regex-match bitmap for each line."""
+    def _match_bits(
+        self, parsed: List[ParsedLine], pre_encoded=None
+    ) -> np.ndarray:
+        """[N, n_rules] uint8 — exact regex-match bitmap for each line.
+
+        `pre_encoded` = (cls_ids, lens, host_eval) from the native parse
+        pass; when given, the Python re-encode is skipped (prefilter mode
+        encodes its own two-stage tensors and ignores it)."""
         n = len(parsed)
         rests = [p.rest for p in parsed]
 
@@ -395,7 +475,7 @@ class TpuMatcher(Matcher):
             bits, host_eval = self._prefilter.match_bits(rests)
             device_rows = np.flatnonzero(~host_eval)
         elif self._mesh_matcher is not None:
-            cls_ids, lens, host_eval = encode_for_match(
+            cls_ids, lens, host_eval = pre_encoded or encode_for_match(
                 self.compiled, rests, self._max_len
             )
             bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
@@ -408,7 +488,7 @@ class TpuMatcher(Matcher):
                     cls_ids[rows], lens[rows]
                 )
         else:
-            cls_ids, lens, host_eval = encode_for_match(
+            cls_ids, lens, host_eval = pre_encoded or encode_for_match(
                 self.compiled, rests, self._max_len
             )
             bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)

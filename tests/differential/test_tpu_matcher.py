@@ -236,3 +236,43 @@ class TestGenerativeStress:
             assert all(len(r.rule_results) == 1 for r in tpu_results)
             assert cpu_banner.regex_ban_logs == tpu_banner.regex_ban_logs
             assert len(tpu_banner.bans) == n_rules
+
+
+def test_native_parse_path_identical_to_python_parse_path():
+    """TpuMatcher with the native C parse pass vs matcher_native_parse:
+    false — identical result streams on a stream salted with every parse
+    corner case (errors, stale, exotic timestamps, non-ASCII, over-length)."""
+    from banjax_tpu import native
+
+    if not native.available():
+        pytest.skip("no C compiler")
+    now = time.time()
+    lines = [
+        f"{now:.6f} 10.1.1.{i % 5} GET example.com GET /p{i} HTTP/1.1 UA"
+        for i in range(40)
+    ] + [
+        "garbage",
+        f"{now - 60:.6f} 1.1.1.1 GET example.com GET /old HTTP/1.1 UA",
+        f"1_{int(now)} 2.2.2.2 GET example.com GET /underscore HTTP/1.1 UA",
+        "nan 3.3.3.3 GET example.com GET /nan HTTP/1.1 UA",
+        f"{now:.6f} 4.4.4.4 GET example.com GET /café HTTP/1.1 UA",
+        f"{now:.6f} 12.12.12.12 GET example.com GET /allowlisted HTTP/1.1 UA",
+        f"{now:.6f} 5.5.5.5 POST example.com POST /{'x' * 300} HTTP/1.1 UA",
+        f"{now:.6f} 6.6.6.6 DELETE skipme.com DELETE /y HTTP/1.1 UA",
+    ]
+
+    outs = []
+    for native_on in (True, False):
+        config = config_from_yaml_text(CONFIG_YAML)
+        config.matcher_native_parse = native_on
+        states = RegexRateLimitStates()
+        banner = MockBanner()
+        m = TpuMatcher(config, banner, StaticDecisionLists(config), states)
+        assert m._native == (native_on and native.available())
+        results = m.consume_lines(lines, now)
+        outs.append((
+            [result_key(r) for r in results],
+            [(b.ip, b.decision, b.domain) for b in banner.bans],
+            states.format_states(),
+        ))
+    assert outs[0] == outs[1]

@@ -1,0 +1,126 @@
+"""Native fastparse vs the Python reference parse/encode — byte-identical
+on every line, including the adversarial timestamp/shape corner cases the
+C side must defer on."""
+
+import time
+
+import numpy as np
+import pytest
+
+from banjax_tpu import native
+from banjax_tpu.matcher.encode import encode_for_match, parse_line
+from banjax_tpu.matcher.rulec import compile_rules
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C compiler in this environment"
+)
+
+COMPILED = compile_rules([r"GET /wp-login\.php", r"(GET|POST) /[a-z]*\.php", r".*"])
+MAX_LEN = 96
+NOW = 1_753_800_000.0
+
+LINES = [
+    f"{NOW:.6f} 1.2.3.4 GET example.com GET /wp-login.php HTTP/1.1 UA",
+    f"{NOW - 5:.3f} 10.0.0.1 POST site.org POST /x.php HTTP/1.1 -",
+    f"{NOW - 11:.6f} 9.9.9.9 GET old.com GET / HTTP/1.1 UA",  # stale
+    "not enough",                       # 1 space: parse error
+    "",                                 # empty: error
+    f"{NOW:.6f} 5.5.5.5 nospace",       # rest with no space: error
+    f"{NOW:.6f} 5.5.5.5 a b",           # rest with 1 space: error
+    f"{NOW:.6f} 5.5.5.5 a b ",          # trailing space: 3 parts, empty rest2
+    "nan 1.2.3.4 GET h.com GET /",      # nan ts: Python error (defer path)
+    "inf 1.2.3.4 GET h.com GET /",      # inf ts: Python error
+    "1_700_000_000 1.2.3.4 GET h.com GET /",  # underscores: Python ACCEPTS
+    "1e30 1.2.3.4 GET h.com GET /",     # int64 overflow: Python error
+    "-5.5 1.2.3.4 GET h.com GET /",     # negative ts: valid, very old
+    f"{NOW:.6f} 8.8.8.8 GET h.com GET /café HTTP/1.1",  # non-ASCII
+    f"{NOW:.6f} 7.7.7.7 GET h.com GET /{'a' * 200} HTTP/1.1",  # over max_len
+    f"{NOW:.6f} 6.6.6.6 GET h.com GET / HTTP/1.1 " + "x" * (MAX_LEN - 30),
+    f"  {NOW:.6f} 1.2.3.4 GET h.com GET /",  # leading space: empty ts field
+]
+
+
+def test_differential_vs_python_reference():
+    nb = native.parse_encode_batch(
+        LINES, COMPILED.byte_to_class, MAX_LEN, NOW, 10.0
+    )
+    assert nb is not None and nb.n == len(LINES)
+    for i, line in enumerate(LINES):
+        want = parse_line(line, NOW, 10.0)
+        f = int(nb.flags[i])
+        if f & native.FLAG_DEFER:
+            continue  # contract: caller re-parses with Python — always safe
+        assert bool(f & native.FLAG_ERROR) == want.error, (i, line)
+        if want.error:
+            continue
+        assert bool(f & native.FLAG_OLD) == want.old_line, (i, line)
+        assert nb.ip(i) == want.ip
+        assert int(nb.ts_ns[i]) == want.timestamp_ns, (i, line)
+        if want.old_line:
+            continue
+        assert nb.host(i) == want.host
+        assert nb.rest(i) == want.rest
+        cls_ref, lens_ref, host_eval_ref = encode_for_match(
+            COMPILED, [want.rest], MAX_LEN
+        )
+        assert bool(f & native.FLAG_HOST_EVAL) == bool(host_eval_ref[0]), (i, line)
+        if not host_eval_ref[0]:
+            assert nb.lens[i] == lens_ref[0]
+            assert (nb.cls_ids[i] == cls_ref[0]).all(), (i, line)
+
+
+def test_defer_covers_python_divergences():
+    """Every line whose timestamp text C cannot prove plain must defer —
+    in particular the underscore form Python float() accepts."""
+    nb = native.parse_encode_batch(
+        LINES, COMPILED.byte_to_class, MAX_LEN, NOW, 10.0
+    )
+    for i, line in enumerate(LINES):
+        ts_field = line.split(" ", 1)[0] if " " in line else line
+        exotic = any(c in ts_field for c in "_") or ts_field.lower() in (
+            "nan", "inf", "-inf", "+inf", "infinity",
+        ) or ts_field == "1e30"
+        if exotic:
+            assert int(nb.flags[i]) & native.FLAG_DEFER, (i, line)
+
+
+def test_random_fuzz_against_reference():
+    rng = np.random.default_rng(0)
+    charset = list("abc ./:0123456789eE+-_é")
+    lines = []
+    for _ in range(500):
+        n = int(rng.integers(0, 60))
+        lines.append("".join(charset[int(k)] for k in rng.integers(0, len(charset), n)))
+    nb = native.parse_encode_batch(lines, COMPILED.byte_to_class, MAX_LEN, NOW, 10.0)
+    for i, line in enumerate(lines):
+        f = int(nb.flags[i])
+        if f & native.FLAG_DEFER:
+            continue
+        want = parse_line(line, NOW, 10.0)
+        assert bool(f & native.FLAG_ERROR) == want.error, repr(line)
+        if want.error:
+            continue
+        assert bool(f & native.FLAG_OLD) == want.old_line, repr(line)
+        assert int(nb.ts_ns[i]) == want.timestamp_ns, repr(line)
+        if not want.old_line:
+            assert nb.ip(i) == want.ip and nb.host(i) == want.host
+            assert nb.rest(i) == want.rest
+
+
+def test_throughput_beats_python_parse():
+    """The native pass must be well ahead of the Python loop (the point)."""
+    lines = [
+        f"{NOW:.6f} 10.{i % 256}.{i % 17}.{i % 251} GET example.com GET "
+        f"/path/{i} HTTP/1.1 Mozilla/5.0 | 200"
+        for i in range(20_000)
+    ]
+    t0 = time.perf_counter()
+    nb = native.parse_encode_batch(lines, COMPILED.byte_to_class, MAX_LEN, NOW, 10.0)
+    native_s = time.perf_counter() - t0
+    assert not (np.asarray(nb.flags) & native.FLAG_DEFER).any()
+    t0 = time.perf_counter()
+    parsed = [parse_line(l, NOW, 10.0) for l in lines]
+    encode_for_match(COMPILED, [p.rest for p in parsed], MAX_LEN)
+    python_s = time.perf_counter() - t0
+    print(f"native {len(lines)/native_s:,.0f} lps vs python {len(lines)/python_s:,.0f} lps")
+    assert native_s * 2 < python_s  # conservative: usually 10-30x
