@@ -185,16 +185,26 @@ def _ssl_context(config: Config) -> Optional[ssl.SSLContext]:
     if not want_tls:
         return None
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-    ctx.check_hostname = False
-    ctx.verify_mode = ssl.CERT_NONE  # reference: InsecureSkipVerify (XXX)
+    if config.kafka_ssl_ca:
+        # an explicitly configured trust root is honored: verify the broker
+        # chain and hostname against it (the reference's InsecureSkipVerify
+        # would silently ignore it — surprising enough to diverge from)
+        ctx.check_hostname = True
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(config.kafka_ssl_ca)
+    else:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE  # reference: InsecureSkipVerify (XXX)
+        log.warning(
+            "KAFKA: no kafka_ssl_ca configured; broker certificate "
+            "verification is DISABLED"
+        )
     if config.kafka_ssl_cert:
         ctx.load_cert_chain(
             config.kafka_ssl_cert,
             keyfile=config.kafka_ssl_key or None,
             password=config.kafka_ssl_key_password or None,
         )
-    if config.kafka_ssl_ca:
-        ctx.load_verify_locations(config.kafka_ssl_ca)
     return ctx
 
 
@@ -429,6 +439,11 @@ def _decode_record_batches(data: bytes) -> List[Tuple[int, bytes]]:
         batch.i16()  # producer_epoch
         batch.i32()  # base_sequence
         n_records = batch.i32()
+        if attrs & 0x20:
+            # control batch (transaction commit/abort markers): not data —
+            # yielding them would hand marker bytes to the command parser
+            # (kafka-go filters these out client-side too)
+            continue
         payload = batch._take(batch.remaining())
         codec = attrs & 0x07
         if codec == 1:

@@ -93,11 +93,13 @@ class PallasRules:
         return self.n_shards * self.wps_p
 
     def jitted(self, B: int, L_p: int, block_b: int, interpret: bool,
-               pack: bool = False):
-        key = (B, L_p, block_b, interpret, pack)
+               pack: bool = False, cols: int = _COLS_PER_STEP):
+        key = (B, L_p, block_b, interpret, pack, cols)
         fn = self._fns.get(key)
         if fn is None:
-            fn = jax.jit(device_matcher(self, B, L_p, block_b, interpret, pack))
+            fn = jax.jit(
+                device_matcher(self, B, L_p, block_b, interpret, pack, cols)
+            )
             self._fns[key] = fn
         return fn
 
@@ -191,8 +193,8 @@ def prepare(compiled: CompiledRules) -> PallasRules:
 
 
 def _kernel(maxtile_ref, cls_rows_ref, lens_ref, btab_ref, masks_ref,
-            out_ref, d_ref, *, C, W, use_roll):
-    """One (line-block, rule-shard, byte-tile) grid step: 8 byte columns."""
+            out_ref, d_ref, *, C, W, use_roll, cols):
+    """One (line-block, rule-shard, byte-tile) grid step: `cols` byte columns."""
     i = pl.program_id(0)
     t = pl.program_id(2)
     bB = cls_rows_ref.shape[1]
@@ -219,25 +221,27 @@ def _kernel(maxtile_ref, cls_rows_ref, lens_ref, btab_ref, masks_ref,
         cls_iota = jax.lax.broadcasted_iota(jnp.int32, (C, bB), 0)
         d = d_ref[:]
         acc = out_ref[:]
-        for k in range(_COLS_PER_STEP):
+        for k in range(cols):
             cls_row = cls_rows_ref[k : k + 1, :]                # [1, bB]
             onehot = (cls_row == cls_iota).astype(jnp.int8)     # [C, bB]
             # MXU gather at the int8 rate: each one-hot column selects one
             # biased row value v-128; +128 restores the exact byte plane.
-            planes = jax.lax.dot_general(
-                btab_ref[:], onehot, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32,
-            )  # [4W, bB] values in [-128, 127]
+            # One dot per 8-bit plane keeps the int32 transient at [W, bB]
+            # (a single [4W, C] dot would transiently hold 4x that in VMEM,
+            # which caps block_b at small sizes).
             # Recombine biased planes in wrapping int32 arithmetic: mod 2^32,
             # Σ (v_k - 128) << 8k  =  (Σ v_k << 8k) - 0x80808080, so adding
             # 0x80808080 back yields exactly the OR of the unbiased byte
             # planes (they occupy disjoint bit lanes).
-            s = (
-                planes[:W]
-                + (planes[W : 2 * W] << 8)
-                + (planes[2 * W : 3 * W] << 16)
-                + (planes[3 * W :] << 24)
-            )
+            s = None
+            for plane in range(4):
+                p = jax.lax.dot_general(
+                    btab_ref[plane * W : (plane + 1) * W, :], onehot,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )  # [W, bB] values in [-128, 127]
+                p = p << (8 * plane) if plane else p
+                s = p if s is None else s + p
             bmask = (s + jnp.int32(-0x7F7F7F80)).astype(jnp.uint32)
             c31 = d >> 31
             if use_roll:
@@ -255,7 +259,7 @@ def _kernel(maxtile_ref, cls_rows_ref, lens_ref, btab_ref, masks_ref,
                 inject = inj_always
             d = ((shifted | inject) | (d & selfloop)) & bmask
             acc = acc | (d & acc_any)
-            l = t * _COLS_PER_STEP + k
+            l = t * cols + k
             acc = acc | jnp.where(last_col == l, d & acc_end, zero)
         d_ref[:] = d
         out_ref[:] = acc
@@ -263,14 +267,17 @@ def _kernel(maxtile_ref, cls_rows_ref, lens_ref, btab_ref, masks_ref,
 
 def device_matcher(prep: PallasRules, B: int, L_p: int,
                    block_b: int = _DEFAULT_BLOCK_B, interpret: bool = False,
-                   pack: bool = False):
+                   pack: bool = False, cols: int = _COLS_PER_STEP):
     """Build the traceable device step: fn(cls_t [L_p, B], lens [B]) →
     matched [B, n_rules] uint8 (or [B, ceil(n_rules/8)] bit-packed when
     `pack` — 8× less device→host traffic for the runner's bitmap pull).
     Composable inside an outer jit (the bench harness chains it; the
-    runner jits it standalone)."""
+    runner jits it standalone). `cols` = byte columns per grid step:
+    wider tiles amortize the per-step Mosaic overhead (measured ~10-15µs
+    per step on v5e) at the cost of L_p padding up to a `cols` multiple."""
     call = _build_raw_call(
-        B, L_p, prep.n_classes_p, prep.n_shards, prep.wps_p, block_b, interpret
+        B, L_p, prep.n_classes_p, prep.n_shards, prep.wps_p, block_b,
+        interpret, cols
     )
     acc_word, acc_mask = prep.acc_word, prep.acc_mask
     branch_rule = prep.branch_rule
@@ -281,7 +288,7 @@ def device_matcher(prep: PallasRules, B: int, L_p: int,
     def fn(cls_t, lens):
         # per-line-block byte-tile counts for the kernel's tile skip
         maxtile = jnp.asarray(
-            -(-lens.reshape(B // block_b, block_b).max(axis=1) // _COLS_PER_STEP),
+            -(-lens.reshape(B // block_b, block_b).max(axis=1) // cols),
             dtype=jnp.int32,
         )
         acc_t = call(maxtile, cls_t, lens[None, :], btab_t, masks_t)  # [ns*wps_p, B]
@@ -302,28 +309,37 @@ def device_matcher(prep: PallasRules, B: int, L_p: int,
     return fn
 
 
-@functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=64)
 def _build_raw_call(
-    B: int, L_p: int, C: int, ns: int, wps_p: int, block_b: int, interpret: bool
+    B: int, L_p: int, C: int, ns: int, wps_p: int, block_b: int,
+    interpret: bool, cols: int = _COLS_PER_STEP,
+    force_roll: "bool | None" = None,
 ):
-    if B % block_b or L_p % _COLS_PER_STEP:
+    if B % block_b or L_p % cols:
         # a floor-divided grid would silently skip the tail of the batch
         raise PallasUnsupported(
             f"B={B} must be a multiple of block_b={block_b} and "
-            f"L_p={L_p} a multiple of {_COLS_PER_STEP} (pad first, "
+            f"L_p={L_p} a multiple of cols={cols} (pad first, "
             "as match_batch_pallas does)"
         )
-    grid = (B // block_b, ns, L_p // _COLS_PER_STEP)
-    kern = functools.partial(_kernel, C=C, W=wps_p, use_roll=not interpret)
+    grid = (B // block_b, ns, L_p // cols)
+    # the pltpu.roll carry is what production (compiled Mosaic) runs; it
+    # also works under interpret, which is how CI covers the exact
+    # production branch (tests/unit/test_nfa_pallas.py::test_roll_branch) —
+    # the concatenate fallback stays for interpreters where roll regresses
+    use_roll = (not interpret) if force_roll is None else force_roll
+    kern = functools.partial(
+        _kernel, C=C, W=wps_p, use_roll=use_roll, cols=cols
+    )
     call = pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,  # maxtile [B // block_b] int32
             grid=grid,
             in_specs=[
-                # cls transposed [L_p, B]: one sublane tile of byte rows per step
+                # cls transposed [L_p, B]: one tile of byte rows per step
                 pl.BlockSpec(
-                    (_COLS_PER_STEP, block_b), lambda i, j, t, mt: (t, i),
+                    (cols, block_b), lambda i, j, t, mt: (t, i),
                     memory_space=pltpu.VMEM,
                 ),
                 pl.BlockSpec(
@@ -364,6 +380,7 @@ def match_batch_pallas(
     block_b: int = _DEFAULT_BLOCK_B,
     interpret: bool = False,
     packed: bool = False,
+    cols: int = _COLS_PER_STEP,
 ) -> np.ndarray:
     """[B, L] encoded lines → [B, n_rules] uint8 match bits via the kernel
     (bit-packed along the rule axis when `packed`).
@@ -384,13 +401,14 @@ def match_batch_pallas(
     # end are pad-class and can't change state), rounded to a multiple of
     # 32 so the number of jitted L_p variants stays small
     max_len = int(lens.max()) if B else 0
-    L_p = max(_COLS_PER_STEP, min(_pad_to(L, _COLS_PER_STEP), _pad_to(max_len, 32)))
+    round_to = max(32, cols)
+    L_p = max(cols, min(_pad_to(L, cols), _pad_to(max_len, round_to)))
     cls_t = np.zeros((L_p, Bp), dtype=np.int32)
     cls_t[: min(L, L_p), :B] = cls_ids[order, : min(L, L_p)].T
     lens_sorted = lens[order]
     if Bp != B:
         lens_sorted = np.pad(lens_sorted, (0, Bp - B))
-    run = prep.jitted(Bp, L_p, block_b, interpret, packed)
+    run = prep.jitted(Bp, L_p, block_b, interpret, packed, cols)
     out = np.asarray(run(jnp.asarray(cls_t), jnp.asarray(lens_sorted)))[:B]
     unsorted = np.empty_like(out)
     unsorted[order] = out

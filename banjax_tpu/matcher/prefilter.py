@@ -32,6 +32,8 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from banjax_tpu.matcher import nfa_jax
@@ -71,10 +73,17 @@ def build_plan(
     min_factor_len: int = 3,
     max_factor_len: int = 12,
     min_filterable_fraction: float = 0.5,
+    byte_classes=None,
 ) -> Optional[PrefilterPlan]:
     """Split `patterns` into the two-stage plan, or None when the ruleset
     doesn't profit (too few filterable rules — the two-pass overhead would
-    outweigh the narrower stage 1)."""
+    outweigh the narrower stage 1).
+
+    `byte_classes` = (byte_to_class, n_classes) of the full single-stage
+    ruleset: both stage tensors are then packed against that shared byte
+    partition, so one `classify_bytes` pass (or the native parse's encode)
+    feeds stage 1, stage 2, AND the single-stage fallback — the layout
+    contract of FusedPrefilter."""
     programs: List[Optional[RuleProgram]] = []
     unsupported: Dict[int, str] = {}
     for i, pat in enumerate(patterns):
@@ -114,8 +123,8 @@ def build_plan(
 
     stage1_programs = [programs[i] for i in always_ids] + factor_progs
     stage2_programs = [programs[i] for i in filt_ids]
-    s1 = pack_programs(stage1_programs, n_shards="auto")
-    s2 = pack_programs(stage2_programs, n_shards="auto")
+    s1 = pack_programs(stage1_programs, n_shards="auto", byte_classes=byte_classes)
+    s2 = pack_programs(stage2_programs, n_shards="auto", byte_classes=byte_classes)
     log.info(
         "prefilter plan: %d always + %d filterable rules, %d distinct factors; "
         "stage1 %d words, stage2 %d words",
@@ -228,3 +237,308 @@ def _bucket(n: int, cap: int) -> int:
     while b < n:
         b <<= 1
     return min(b, max(cap, _MIN_BUCKET))
+
+
+class PrefilterOverflow(RuntimeError):
+    """More stage-1 candidates than the fused pipeline's fixed capacity —
+    the caller must rerun the batch through its single-stage path."""
+
+
+@dataclasses.dataclass
+class _Pending:
+    """An in-flight fused batch: device buffer + host-order bookkeeping."""
+
+    buf: object          # device array, copy_to_host_async already started
+    B: int               # caller rows
+    K: int               # candidate capacity
+    E: int               # matched-row output capacity
+    lens: np.ndarray     # caller-order lens (for empty_only always-rules)
+
+
+class FusedPrefilter:
+    """Single-jit two-stage pipeline: both stages, the candidate gate, the
+    on-device compaction, and the bitmap merge run in ONE device program.
+
+    The host-orchestrated PrefilterMatcher pays a device→host round trip
+    plus a re-encode between the stages; on hardware that host work costs
+    ~20x the kernels themselves (BENCH r3 scratch: 19.7k lines/s fused-host
+    vs 497k single-stage). Here stage 1's candidate vector never leaves the
+    device: `nonzero(size=K)` compacts the candidate lines' already-resident
+    class columns, stage 2 scans only those, and the per-stage bits scatter
+    back into one packed [B, ceil(R/8)] bitmap. Requires a plan built with
+    `byte_classes` of the caller's full ruleset so the caller's encode (or
+    native fastparse output) is consumed verbatim.
+
+    Capacity: K = max(block, ceil(B * cand_frac)) compacted lines. The
+    candidate count is returned with the bitmap; `n_cand > K` raises
+    PrefilterOverflow (soundness: a truncated candidate set would silently
+    under-match) and the caller reruns that batch single-stage — an
+    adversarial all-matching stream degrades to the single-stage rate, never
+    to wrong output.
+    """
+
+    def __init__(self, plan: PrefilterPlan, backend: str,
+                 cand_frac: float = 0.125, out_frac: float = 0.25,
+                 block_b: int = 0, cols: int = 0):
+        """Chunking is the CALLER's job: submit() compiles one device
+        program for exactly the batch shape it is handed (TpuMatcher
+        chunks by its matcher_batch_lines before submitting)."""
+        if plan.stage1.n_classes != plan.stage2.n_classes:
+            raise ValueError("fused plan requires shared byte classes")
+        self.plan = plan
+        self.backend = backend
+        self.interpret = backend == "pallas-interpret"
+        self.cand_frac = cand_frac
+        self.out_frac = out_frac
+        self._pallas = backend in ("pallas", "pallas-interpret")
+        if self._pallas:
+            self._preps = {
+                "s1": nfa_match.prepare(plan.stage1),
+                "s2": nfa_match.prepare(plan.stage2),
+            }
+            # block 512 × cols 32 is the VMEM sweet spot on v5e: wider
+            # blocks OOM the 16 MB scoped-vmem limit once the per-plane dot
+            # transients and the double-buffered out block are counted
+            self._block = block_b or (8 if self.interpret else 512)
+            self._cols = cols or (8 if self.interpret else 32)
+        else:
+            self._params = {
+                "s1": nfa_jax.match_params(plan.stage1),
+                "s2": nfa_jax.match_params(plan.stage2),
+            }
+            self._block = block_b or 8
+            self._cols = cols or 8
+        self._fns = {}
+
+        # Stage-1 gate masks over the RAW accept words — the per-line
+        # "any factor hit" bit needs no branch extraction at all (the
+        # [B, n_branches] gather costs more than the stage-1 scan itself).
+        s1 = plan.stage1
+        if self._pallas:
+            w1 = self._preps["s1"].total_words
+            acc_word = np.asarray(self._preps["s1"].acc_word)
+        else:
+            w1 = s1.n_words
+            acc_word = np.asarray(s1.acc_word)
+        acc_mask = np.asarray(s1.acc_mask, dtype=np.uint32)
+        branch_rule = np.asarray(s1.branch_rule)
+        fmask = np.zeros(w1, dtype=np.uint32)
+        fac = branch_rule >= plan.n_always
+        np.bitwise_or.at(fmask, acc_word[fac], acc_mask[fac])
+        self._fmask = jnp.asarray(fmask)
+        # always-rule extraction (usually a handful of branches)
+        self._a_word = jnp.asarray(acc_word[~fac], dtype=jnp.int32)
+        self._a_mask = jnp.asarray(acc_mask[~fac])
+        self._a_rule = jnp.asarray(branch_rule[~fac], dtype=jnp.int32)
+        # host-static flags for always-rules (applied after decode)
+        self._a_always = np.asarray(s1.always_match[: plan.n_always], dtype=bool)
+        self._a_empty = np.asarray(s1.empty_only[: plan.n_always], dtype=bool)
+        self._nf8 = -(-plan.stage2.n_rules // 8)
+        self._na8 = -(-plan.n_always // 8) if plan.n_always else 0
+
+    # ---- device program ----
+
+    def _stage1_raw(self, B: int, L_p: int, block: int):
+        """[L_p, B] cls + [1, B] lens → raw accept words [W1, B] uint32."""
+        if self._pallas:
+            prep = self._preps["s1"]
+            call = nfa_match._build_raw_call(
+                B, L_p, prep.n_classes_p, prep.n_shards, prep.wps_p, block,
+                self.interpret, self._cols
+            )
+            btab, masks = prep.btab_t, prep.masks_t
+            cols = self._cols
+
+            def fn(cls_t, lens):
+                maxtile = -(-lens.reshape(B // block, block).max(axis=1) // cols)
+                return call(
+                    maxtile.astype(jnp.int32), cls_t, lens[None, :], btab, masks
+                )
+
+            return fn
+        params = self._params["s1"]
+
+        def xla_fn(cls_t, lens):
+            return nfa_jax.nfa_scan(params, cls_t.T, lens).T  # [W1, B]
+
+        return xla_fn
+
+    def _stage2(self, K: int, L_p: int, block: int):
+        """[L_p, K] cls + [K] lens → [K, nf8] packed match bits."""
+        if self._pallas:
+            return nfa_match.device_matcher(
+                self._preps["s2"], K, L_p, block, interpret=self.interpret,
+                pack=True, cols=self._cols,
+            )
+        params = self._params["s2"]
+        n_filt = self.plan.stage2.n_rules
+
+        def xla_fn(cls_t, lens):
+            return nfa_jax.match_batch_packed(params, cls_t.T, lens, n_filt)
+
+        return xla_fn
+
+    def _block_for(self, B: int) -> int:
+        """Largest usable line-block: compiled Mosaic requires a lane
+        multiple (128), interpret/XLA just need block <= B."""
+        if self._pallas and not self.interpret:
+            return self._block if B >= self._block else 128
+        return min(self._block, max(1, B))
+
+    def _fused(self, B: int, L_p: int):
+        key = (B, L_p)
+        hit = self._fns.get(key)
+        if hit is not None:
+            return hit
+        plan = self.plan
+        block = self._block_for(B)
+        K = min(B, max(block, -(-int(B * self.cand_frac) // block) * block))
+        # matched-row output capacity (matched ⊆ candidates)
+        E = min(K, max(64, int(K * self.out_frac)))
+        f1 = self._stage1_raw(B, L_p, block)
+        f2 = self._stage2(K, L_p, min(block, K))
+        n_always = plan.n_always
+        fmask = self._fmask
+        a_word, a_mask, a_rule = self._a_word, self._a_mask, self._a_rule
+        na8 = self._na8
+        shifts = jnp.asarray([0, 8, 16, 24], dtype=jnp.int32)
+
+        @jax.jit
+        def fused(cls_and_lens):
+            """[B, L_p + 1] int32 (lens folded into the last column: one h2d
+            transfer instead of two — the tunnel charges fixed latency per
+            transfer) → one uint8 buffer:
+              n_cand[4] ‖ n_matched[4] ‖ matched caller-row idx[4E] ‖
+              matched packed rule rows [E * nf8] ‖ always-rule bits [B * na8].
+            A single buffer = a single device→host pull — the tunnel charges
+            ~65 ms of fixed latency per pull regardless of size, so the
+            sparse result must come back in one piece (and overlapped, see
+            submit/collect). Two compaction levels: stage 1's factor gate
+            selects K candidate lines for stage 2, and only candidates that
+            actually MATCHED a rule (typically a few %) are shipped back.
+            Length-sort, transpose, and the sorted→caller index mapping all
+            happen on device: the host does no O(B·L) work at all."""
+            cls_rows = cls_and_lens[:, :-1]                      # [B, L_p]
+            lens_raw = cls_and_lens[:, -1]                       # [B]
+            order = jnp.argsort(lens_raw)                        # ascending
+            lens = jnp.take(lens_raw, order)
+            cls_t = jnp.take(cls_rows, order, axis=0).T          # [L_p, B]
+            acc1 = f1(cls_t, lens)                               # [W1, B]
+            cand = (acc1 & fmask[:, None]).max(axis=0) > 0       # [B]
+            n_cand = jnp.sum(cand.astype(jnp.int32))
+            (idx,) = jnp.nonzero(cand, size=K, fill_value=0)     # [K] ascending
+            valid = jax.lax.iota(jnp.int32, K) < n_cand
+            cls2_t = jnp.take(cls_t, idx, axis=1)                # [L_p, K]
+            lens2 = jnp.where(valid, jnp.take(lens, idx), 0)
+            m2p = f2(cls2_t, lens2) & (valid[:, None] * jnp.uint8(0xFF))
+            # second compaction: only candidate rows with at least one rule
+            # bit set go home
+            hit = m2p.max(axis=1) > 0                            # [K]
+            n_m = jnp.sum(hit.astype(jnp.int32))
+            (midx,) = jnp.nonzero(hit, size=E, fill_value=0)     # [E]
+            mvalid = jax.lax.iota(jnp.int32, E) < n_m
+            rows = jnp.take(m2p, midx, axis=0) & (
+                mvalid[:, None] * jnp.uint8(0xFF)
+            )
+            idx_caller = jnp.take(order, jnp.take(idx, midx))    # caller rows
+            idx_caller = jnp.where(mvalid, idx_caller, -1)
+            parts = [
+                ((n_cand[None] >> shifts) & 0xFF).astype(jnp.uint8),
+                ((n_m[None] >> shifts) & 0xFF).astype(jnp.uint8),
+                ((idx_caller[:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                rows.reshape(-1),
+            ]
+            if n_always:
+                sel = (acc1[a_word, :] & a_mask[:, None]) != 0   # [n_abr, B]
+                ab = jnp.zeros((n_always, acc1.shape[1]), dtype=jnp.uint8)
+                ab = ab.at[a_rule].max(sel.astype(jnp.uint8))
+                # back to caller row order before packing
+                ab_caller = jnp.zeros_like(ab.T).at[order].set(ab.T)
+                parts.append(
+                    jnp.packbits(ab_caller.astype(jnp.bool_), axis=1).reshape(-1)
+                )
+            return jnp.concatenate(parts)
+
+        self._fns[key] = (fused, K, E)
+        return fused, K, E
+
+    # ---- host API ----
+
+    def submit(self, cls_ids: np.ndarray, lens: np.ndarray) -> _Pending:
+        """Dispatch one batch; returns a handle whose device→host copy is
+        already in flight. Pipelining batches through submit/collect hides
+        the tunnel's fixed d2h latency behind the next batch's compute.
+
+        Host cost is one [B, L_p + 1] int32 assembly (a row-slice copy; no
+        gather, no transpose — those run on device)."""
+        cls_ids = np.asarray(cls_ids, dtype=np.int32)
+        lens = np.asarray(lens, dtype=np.int32)
+        B = cls_ids.shape[0]
+        block = self._block_for(max(_MIN_BUCKET, B))
+        Bp = max(block, -(-max(1, B) // block) * block)
+        cols = self._cols
+        max_len = int(lens.max()) if B else 0
+        L_p = max(cols, min(
+            -(-cls_ids.shape[1] // cols) * cols,
+            -(-max(1, max_len) // max(32, cols)) * max(32, cols),
+        ))
+        combined = np.zeros((Bp, L_p + 1), dtype=np.int32)
+        if B:
+            combined[:B, : min(cls_ids.shape[1], L_p)] = cls_ids[:, :L_p]
+            combined[:B, -1] = lens
+        fn, K, E = self._fused(Bp, L_p)
+        buf = fn(jnp.asarray(combined))
+        try:
+            buf.copy_to_host_async()
+        except AttributeError:  # interpret/CPU arrays may lack the method
+            pass
+        return _Pending(buf=buf, B=B, K=K, E=E, lens=lens)
+
+    def collect(self, p: _Pending) -> np.ndarray:
+        """Block on a submit()ed batch → [B, n_rules] uint8 bits in caller
+        row order. Raises PrefilterOverflow when either compaction capacity
+        was exceeded (the caller reruns the batch single-stage)."""
+        plan = self.plan
+        buf = np.asarray(p.buf)
+        K, E, B = p.K, p.E, p.B
+        head = np.frombuffer(buf[:8].tobytes(), dtype="<i4")
+        n_cand, n_m = int(head[0]), int(head[1])
+        if n_cand > K:
+            raise PrefilterOverflow(f"{n_cand} candidates > capacity {K}")
+        if n_m > E:
+            raise PrefilterOverflow(f"{n_m} matched rows > capacity {E}")
+        idx = np.frombuffer(buf[8 : 8 + 4 * E].tobytes(), dtype="<i4")
+        off = 8 + 4 * E
+        rows = buf[off : off + E * self._nf8].reshape(E, self._nf8)
+        bits = np.zeros((B, plan.n_rules), dtype=np.uint8)
+        if n_m:
+            live = idx[:n_m]
+            keep = (live >= 0) & (live < B)
+            filt = np.unpackbits(
+                rows[:n_m][keep], axis=1, count=plan.stage2.n_rules
+            )
+            bits[np.ix_(live[keep], plan.f_idx)] = filt
+        if plan.n_always:
+            off += E * self._nf8
+            ap = buf[off:].reshape(-1, self._na8)[:B]  # caller-order rows
+            abits = np.unpackbits(ap, axis=1, count=plan.n_always)
+            abits[:, self._a_always] = 1
+            if self._a_empty.any():
+                abits[p.lens == 0] |= self._a_empty.astype(np.uint8)
+            bits[:, plan.a_idx] = abits
+        return bits
+
+    def match_bits_encoded(
+        self, cls_ids: np.ndarray, lens: np.ndarray
+    ) -> np.ndarray:
+        """[B, L] shared-class ids → [B, n_rules] uint8 device-decided bits.
+
+        Same output contract as PrefilterMatcher.match_bits's first value
+        (unsupported-rule columns all zero); raises PrefilterOverflow when
+        the candidate capacity is exceeded. Sorts by length internally
+        (pays off in both stages' tile-skip) and restores caller order.
+        """
+        if np.asarray(cls_ids).shape[0] == 0:
+            return np.zeros((0, self.plan.n_rules), dtype=np.uint8)
+        return self.collect(self.submit(cls_ids, lens))

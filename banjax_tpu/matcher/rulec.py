@@ -818,12 +818,21 @@ def pack_programs(
     programs: Sequence[Optional[RuleProgram]],
     n_shards=1,
     unsupported: Optional[Dict[int, str]] = None,
+    byte_classes: Optional[Tuple[np.ndarray, int]] = None,
 ) -> CompiledRules:
     """Pack already-lowered rule programs into the transition tensors.
 
     Split out of compile_rules so synthetic programs (e.g. the literal
     prefilter's factor automata, matcher/prefilter.py) share the packing
     and the match kernels without a regex round-trip.
+
+    `byte_classes` = (byte_to_class [256] int32, n_classes): use this
+    pre-computed byte partition instead of deriving one from the programs'
+    charsets. The partition must REFINE every position charset (all bytes of
+    a class agree on membership) — e.g. the partition of a superset ruleset.
+    This is what lets the two-stage prefilter share one encode pass with the
+    full single-stage tensors: all three CompiledRules index the same class
+    ids, so lines are classified once (matcher/prefilter.py).
     """
     n_rules = len(programs)
     unsupported = dict(unsupported or {})
@@ -869,18 +878,33 @@ def pack_programs(
                 cs_index[p.cs] = len(charsets)
                 charsets.append(p.cs)
 
-    # signature of byte b = tuple of membership bits; identical signature →
-    # same class. Class ids start at 1; 0 is the reserved pad class.
-    sig_to_class: Dict[Tuple[int, ...], int] = {}
-    byte_to_class = np.zeros(256, dtype=np.int32)
-    for b in range(256):
-        sig = tuple((cs >> b) & 1 for cs in charsets)
-        cls = sig_to_class.get(sig)
-        if cls is None:
-            cls = len(sig_to_class) + 1
-            sig_to_class[sig] = cls
-        byte_to_class[b] = cls
-    n_classes = len(sig_to_class) + 1
+    if byte_classes is not None:
+        byte_to_class, n_classes = byte_classes
+        byte_to_class = np.asarray(byte_to_class, dtype=np.int32)
+        # refinement check: every class must be uniform w.r.t. every charset,
+        # otherwise a representative-byte membership test would be wrong
+        for cs in charsets:
+            member = np.array([(cs >> b) & 1 for b in range(256)], dtype=np.int64)
+            if len(set(zip(byte_to_class.tolist(), member.tolist()))) > len(
+                set(byte_to_class.tolist())
+            ):
+                raise ValueError(
+                    "byte_classes does not refine a position charset; "
+                    "pack with the partition of a superset ruleset"
+                )
+    else:
+        # signature of byte b = tuple of membership bits; identical signature
+        # → same class. Class ids start at 1; 0 is the reserved pad class.
+        sig_to_class: Dict[Tuple[int, ...], int] = {}
+        byte_to_class = np.zeros(256, dtype=np.int32)
+        for b in range(256):
+            sig = tuple((cs >> b) & 1 for cs in charsets)
+            cls = sig_to_class.get(sig)
+            if cls is None:
+                cls = len(sig_to_class) + 1
+                sig_to_class[sig] = cls
+            byte_to_class[b] = cls
+        n_classes = len(sig_to_class) + 1
 
     b_table = np.zeros((n_classes, W), dtype=np.uint64)
     shift_in = np.zeros(W, dtype=np.uint64)

@@ -230,7 +230,9 @@ class TpuMatcher(Matcher):
 
         # two-stage literal prefilter (matcher/prefilter.py): compile-time
         # rearrangement, bit-identical output; auto-disabled when the
-        # ruleset has too few filterable rules
+        # ruleset has too few filterable rules. The fused variant shares
+        # this matcher's byte classes, so the native parse's encode feeds
+        # it directly and the whole two-stage pipeline is one device call.
         self._prefilter = None
         if self._mesh_matcher is not None and getattr(config, "matcher_prefilter", True):
             log.info(
@@ -238,10 +240,15 @@ class TpuMatcher(Matcher):
                 "full sharded NFA per batch"
             )
         if getattr(config, "matcher_prefilter", True) and self._mesh_matcher is None:
-            from banjax_tpu.matcher.prefilter import PrefilterMatcher, build_plan
+            from banjax_tpu.matcher.prefilter import FusedPrefilter, build_plan
 
             try:
-                plan = build_plan([r.regex_string for _, r in self._entries])
+                plan = build_plan(
+                    [r.regex_string for _, r in self._entries],
+                    byte_classes=(
+                        self.compiled.byte_to_class, self.compiled.n_classes
+                    ),
+                )
             except Exception:  # noqa: BLE001 — a plan bug must not kill the matcher
                 log.exception("prefilter plan construction failed; single-stage")
                 plan = None
@@ -253,11 +260,15 @@ class TpuMatcher(Matcher):
                 else:
                     pf_backend = "xla"
                 try:
-                    self._prefilter = PrefilterMatcher(
-                        plan, pf_backend, self._max_len, self._max_batch
-                    )
+                    self._prefilter = FusedPrefilter(plan, pf_backend)
                 except pallas_nfa.PallasUnsupported as e:
                     log.info("prefilter unavailable (%s); single-stage", e)
+
+        # per-host per-site-then-global rule order as index arrays, so the
+        # replay loops touch only matched rules instead of iterating the
+        # whole ruleset per line (regex_rate_limiter.go:175-211 order)
+        self._rule_order_cache: Dict[str, np.ndarray] = {}
+        self._global_order_arr = np.asarray(self._global_idx, dtype=np.int64)
 
     # ---- Matcher API ----
 
@@ -372,14 +383,18 @@ class TpuMatcher(Matcher):
             return results
 
         # 3b. host window pass in original line order: per-site rules for the
-        #     line's host first, then global rules (regex_rate_limiter.go:175-211)
+        #     line's host first, then global rules (regex_rate_limiter.go:175-211).
+        #     Lines with no match at all (the overwhelming majority) are
+        #     skipped wholesale; matched lines touch only their matched rule
+        #     ids, in order — O(matches), not O(lines × rules) Python.
+        row_any = bits.any(axis=1)
         for row, (i, p) in enumerate(work):
-            rule_order = self._per_site_idx.get(p.host, []) + self._global_idx
+            if not row_any[row]:
+                continue
+            ord_arr = self._rule_order_np(p.host)
             try:
-                for idx in rule_order:
+                for idx in ord_arr[bits[row, ord_arr] != 0]:
                     _, rule = self._entries[idx]
-                    if not bits[row, idx]:
-                        continue
                     results[i].rule_results.append(
                         self._apply_matched_rule(rule, p)
                     )
@@ -418,22 +433,34 @@ class TpuMatcher(Matcher):
             self._apply_device_windows(work[:mid], bits[:mid], results)
             self._apply_device_windows(work[mid:], bits[mid:], results)
             return
-        ts_s, ts_ns = split_ns(np.array([p.timestamp_ns for _, p in work]))
-        host_idx = np.array(
-            [self._host_row.get(p.host, 0) for _, p in work], dtype=np.int32
-        )
-        events = self.device_windows.apply_bitmap(
-            bits, slots, ts_s, ts_ns, self._active_table, host_idx
-        )
+        # pins must be released exactly once: apply_bitmap owns them from
+        # the moment it's entered (its finally releases on every path); any
+        # failure BEFORE that (e.g. an unrepresentable timestamp in
+        # split_ns) must release here or the slots stay unevictable forever
+        handed_off = False
+        try:
+            ts_s, ts_ns = split_ns(np.array([p.timestamp_ns for _, p in work]))
+            host_idx = np.array(
+                [self._host_row.get(p.host, 0) for _, p in work], dtype=np.int32
+            )
+            handed_off = True
+            events = self.device_windows.apply_bitmap(
+                bits, slots, ts_s, ts_ns, self._active_table, host_idx
+            )
+        except Exception:
+            if not handed_off:
+                self.device_windows.release_pins(slots)
+            raise
         evmap = {(e.line, e.rule_id): e for e in events}
 
+        row_any = bits.any(axis=1)
         for row, (i, p) in enumerate(work):
-            rule_order = self._per_site_idx.get(p.host, []) + self._global_idx
+            if not row_any[row]:
+                continue
+            ord_arr = self._rule_order_np(p.host)
             try:
-                for idx in rule_order:
+                for idx in ord_arr[bits[row, ord_arr] != 0]:
                     _, rule = self._entries[idx]
-                    if not bits[row, idx]:
-                        continue
                     result = RuleResult(rule_name=rule.rule, regex_match=True)
                     if rule.hosts_to_skip.get(p.host):
                         result.skip_host = True
@@ -466,14 +493,48 @@ class TpuMatcher(Matcher):
         """[N, n_rules] uint8 — exact regex-match bitmap for each line.
 
         `pre_encoded` = (cls_ids, lens, host_eval) from the native parse
-        pass; when given, the Python re-encode is skipped (prefilter mode
-        encodes its own two-stage tensors and ignores it)."""
+        pass; when given, the Python re-encode is skipped. The fused
+        prefilter consumes it directly — its plan is built against THIS
+        matcher's byte classes (build_plan byte_classes=...), so the one
+        encode feeds stage 1, stage 2, and the single-stage fallback."""
         n = len(parsed)
         rests = [p.rest for p in parsed]
 
         if self._prefilter is not None:
-            bits, host_eval = self._prefilter.match_bits(rests)
+            from banjax_tpu.matcher.prefilter import PrefilterOverflow
+
+            cls_ids, lens, host_eval = pre_encoded or encode_for_match(
+                self.compiled, rests, self._max_len
+            )
+            # host_eval rows are decided by host `re` below; zeroing their
+            # length keeps them out of the device bitmap without a gather
+            dev_lens = np.where(host_eval, 0, lens)
             device_rows = np.flatnonzero(~host_eval)
+            try:
+                bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
+                # submit every chunk before collecting any: each chunk's
+                # device→host pull (fixed ~65 ms tunnel latency) overlaps
+                # the next chunk's compute
+                pend = [
+                    (sl, self._prefilter.submit(cls_ids[sl], dev_lens[sl]))
+                    for sl in (
+                        slice(s, min(n, s + self._max_batch))
+                        for s in range(0, n, self._max_batch)
+                    )
+                ]
+                for sl, p in pend:
+                    bits[sl] = self._prefilter.collect(p)
+                # a zero-length row must contribute NO device bits (the
+                # empty_only always-rule reconstruction keys on lens == 0,
+                # which is also how host_eval rows were masked out)
+                bits[host_eval] = 0
+            except PrefilterOverflow as e:
+                # adversarial all-matching traffic: rerun single-stage (the
+                # full-NFA path has no candidate capacity to overflow)
+                log.info("prefilter overflow (%s); batch reruns single-stage", e)
+                bits = self._single_stage_bits(
+                    n, cls_ids, lens, host_eval, device_rows
+                )
         elif self._mesh_matcher is not None:
             cls_ids, lens, host_eval = pre_encoded or encode_for_match(
                 self.compiled, rests, self._max_len
@@ -491,28 +552,10 @@ class TpuMatcher(Matcher):
             cls_ids, lens, host_eval = pre_encoded or encode_for_match(
                 self.compiled, rests, self._max_len
             )
-            bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
             device_rows = np.flatnonzero(~host_eval)
-            for start in range(0, len(device_rows), self._max_batch):
-                rows = device_rows[start : start + self._max_batch]
-                b = _bucket(len(rows), self._max_batch)
-                pad_cls = np.zeros((b, self._max_len), dtype=np.int32)
-                pad_len = np.zeros(b, dtype=np.int32)
-                pad_cls[: len(rows)] = cls_ids[rows]
-                pad_len[: len(rows)] = lens[rows]
-                if self._pallas_prep is not None:
-                    packed = pallas_nfa.match_batch_pallas(
-                        self._pallas_prep, pad_cls, pad_len,
-                        interpret=self._pallas_interpret, packed=True,
-                    )
-                else:
-                    packed = np.asarray(
-                        nfa_jax.match_batch_packed(
-                            self._params, pad_cls, pad_len, self.compiled.n_rules
-                        )
-                    )
-                out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
-                bits[rows] = out[: len(rows)]
+            bits = self._single_stage_bits(
+                n, cls_ids, lens, host_eval, device_rows
+            )
 
         # host fallback: whole lines the device can't decide
         for row in np.flatnonzero(host_eval):
@@ -527,6 +570,51 @@ class TpuMatcher(Matcher):
                 if rule.regex.search(rests[row]) is not None:
                     bits[row, idx] = 1
         return bits
+
+    def _single_stage_bits(
+        self, n: int, cls_ids, lens, host_eval, device_rows
+    ) -> np.ndarray:
+        """Full-NFA match bitmap for the single-device path (also the
+        prefilter's overflow fallback — it has no capacity to exceed)."""
+        bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
+        for start in range(0, len(device_rows), self._max_batch):
+            rows = device_rows[start : start + self._max_batch]
+            b = _bucket(len(rows), self._max_batch)
+            pad_cls = np.zeros((b, self._max_len), dtype=np.int32)
+            pad_len = np.zeros(b, dtype=np.int32)
+            pad_cls[: len(rows)] = cls_ids[rows]
+            pad_len[: len(rows)] = lens[rows]
+            if self._pallas_prep is not None:
+                packed = pallas_nfa.match_batch_pallas(
+                    self._pallas_prep, pad_cls, pad_len,
+                    interpret=self._pallas_interpret, packed=True,
+                )
+            else:
+                packed = np.asarray(
+                    nfa_jax.match_batch_packed(
+                        self._params, pad_cls, pad_len, self.compiled.n_rules
+                    )
+                )
+            out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
+            bits[rows] = out[: len(rows)]
+        return bits
+
+    def _rule_order_np(self, host: str) -> np.ndarray:
+        """Per-site-then-global rule ids as an index array.
+
+        Hosts with no per-site rules share one global array — the host
+        field comes from attacker-controlled log lines, so caching per
+        unknown host would be an unbounded-memory hole; the per-site cache
+        is bounded by the config's site list."""
+        if host not in self._per_site_idx:
+            return self._global_order_arr
+        arr = self._rule_order_cache.get(host)
+        if arr is None:
+            arr = np.asarray(
+                self._per_site_idx[host] + self._global_idx, dtype=np.int64
+            )
+            self._rule_order_cache[host] = arr
+        return arr
 
     def _apply_matched_rule(self, rule: RegexWithRate, p: ParsedLine) -> RuleResult:
         """applyRegexToLog after a confirmed regex match

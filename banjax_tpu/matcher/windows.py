@@ -314,11 +314,20 @@ class DeviceWindows:
 
     # ---- slot management (host) ----
 
-    def slot_for_ip(self, ip: str) -> int:
+    def slot_for_ip(self, ip: str) -> Optional[int]:
+        """Slot for one IP, or None if every slot is pinned by in-flight
+        batches (transient: retry after those batches' apply_bitmap runs)."""
         slots = self.slots_for_ips([ip])
-        assert slots is not None  # a single IP always fits (capacity >= 1)
+        if slots is None:
+            return None
         self._release_pins(slots)  # lookup only — no apply_bitmap will follow
         return int(slots[0])
+
+    def release_pins(self, slot_ids) -> None:
+        """Release a batch's pins when apply_bitmap will NOT be called
+        (apply_bitmap releases its own batch's pins on every path — call
+        exactly one of the two, never both)."""
+        self._release_pins(slot_ids)
 
     def slots_for_ips(self, ips: Sequence[str]) -> Optional[np.ndarray]:
         """Assign a slot per IP for one batch, atomically.

@@ -196,13 +196,17 @@ def _make_certs(tmp_path):
     run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
         "-keyout", "ca.key", "-out", "ca.pem", "-days", "1",
         "-subj", "/CN=fake-ca")
+    # the server cert needs an IP SAN: with kafka_ssl_ca configured the
+    # client now verifies the chain AND the 127.0.0.1 endpoint identity
+    (d / "san.cnf").write_text("subjectAltName=IP:127.0.0.1,DNS:localhost\n")
     for name in ("server", "client"):
         run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
             "-keyout", f"{name}.key", "-out", f"{name}.csr",
             "-subj", f"/CN={name}")
+        ext = (["-extfile", "san.cnf"] if name == "server" else [])
         run("openssl", "x509", "-req", "-in", f"{name}.csr",
             "-CA", "ca.pem", "-CAkey", "ca.key", "-CAcreateserial",
-            "-out", f"{name}.pem", "-days", "1")
+            "-out", f"{name}.pem", "-days", "1", *ext)
     return d
 
 

@@ -144,6 +144,55 @@ class TestPallasKernel:
             with pytest.raises(nfa_match.PallasUnsupported):
                 nfa_match.prepare(compiled)
 
+    def test_roll_branch_matches_fallback(self):
+        """The pltpu.roll carry path — the branch compiled Mosaic runs in
+        production — must agree bit-for-bit with the concatenate fallback.
+        Uses 90-char literals so state genuinely crosses word boundaries
+        (the roll is exactly the cross-word carry)."""
+        import jax.numpy as jnp
+
+        lit = "abcdefghij" * 9
+        pats = [re.escape(lit), re.escape(lit[:40]) + r"\d+" + re.escape(lit[50:])]
+        lines = [lit, lit[:40] + "123" + lit[50:], lit[:-1], "zz" + lit + "zz"]
+        compiled = compile_rules(pats)
+        cls_ids, lens, _ = encode_for_match(compiled, lines, 128)
+        prep = nfa_match.prepare(compiled)
+        B, L = 8, 96
+        cls_t = np.zeros((L, B), dtype=np.int32)
+        cls_t[: cls_ids.shape[1], : len(lines)] = cls_ids[:, :L].T
+        lens_p = np.zeros(B, dtype=np.int32)
+        lens_p[: len(lines)] = lens
+        outs = {}
+        for roll in (False, True):
+            call = nfa_match._build_raw_call(
+                B, L, prep.n_classes_p, prep.n_shards, prep.wps_p,
+                block_b=8, interpret=True, cols=8, force_roll=roll,
+            )
+            maxtile = np.asarray([-(-int(lens_p.max()) // 8)], dtype=np.int32)
+            outs[roll] = np.asarray(
+                call(jnp.asarray(maxtile), jnp.asarray(cls_t),
+                     jnp.asarray(lens_p[None, :]), prep.btab_t, prep.masks_t)
+            )
+        np.testing.assert_array_equal(outs[True], outs[False])
+        assert outs[True].any(), "carry test must produce accept bits"
+
+    @pytest.mark.parametrize("cols", [8, 32])
+    def test_wide_byte_tiles(self, cols):
+        """cols=32 (the TPU production tile width) is semantics-identical
+        to the default 8-column tile."""
+        compiled = compile_rules(REALISTIC_RULES)
+        cls_ids, lens, _ = encode_for_match(compiled, REALISTIC_LINES, 96)
+        prep = nfa_match.prepare(compiled)
+        got = nfa_match.match_batch_pallas(
+            prep, cls_ids, lens, block_b=8, interpret=True, cols=cols
+        )
+        ref = np.asarray(
+            nfa_jax.match_batch(
+                nfa_jax.match_params(compiled), cls_ids, lens, compiled.n_rules
+            )
+        )
+        np.testing.assert_array_equal(got, ref)
+
 
 class TestRunnerBackend:
     def test_tpu_matcher_pallas_interpret_end_to_end(self):

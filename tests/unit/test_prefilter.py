@@ -137,3 +137,136 @@ class TestTwoStageEquivalence:
 
     def test_unprofitable_ruleset_returns_none(self):
         assert build_plan([r".*", r"[a-z]+", r"\d+"]) is None
+
+
+class TestFusedPrefilter:
+    """The single-device-call two-stage pipeline (FusedPrefilter): shared
+    byte classes, on-device gate/compaction, sparse matched-row output."""
+
+    def _plan(self, patterns):
+        from banjax_tpu.matcher.prefilter import FusedPrefilter  # noqa: F401
+
+        compiled = compile_rules(patterns, n_shards="auto")
+        plan = build_plan(
+            patterns,
+            byte_classes=(compiled.byte_to_class, compiled.n_classes),
+        )
+        return compiled, plan
+
+    def _oracle(self, compiled, plan, lines, max_len=128):
+        params = nfa_jax.match_params(compiled)
+        cls_ids, lens, he = encode_for_match(compiled, lines, max_len)
+        want = np.asarray(
+            nfa_jax.match_batch(params, cls_ids, lens, compiled.n_rules)
+        )
+        for rid in plan.unsupported:
+            want[:, rid] = 0
+        return cls_ids, lens, he, want
+
+    @pytest.mark.parametrize("backend", ["xla", "pallas-interpret"])
+    def test_parity_with_single_stage(self, backend):
+        from banjax_tpu.matcher.prefilter import FusedPrefilter
+
+        import bench
+
+        patterns = bench.generate_rules(60, seed=9)
+        lines = bench.generate_lines(300, patterns, seed=10, attack_rate=0.3)
+        compiled, plan = self._plan(patterns)
+        assert plan is not None
+        cls_ids, lens, he, want = self._oracle(compiled, plan, lines)
+        assert not he.any()
+        fp = FusedPrefilter(plan, backend, cand_frac=1.0, out_frac=1.0)
+        bits = fp.match_bits_encoded(cls_ids, lens)
+        np.testing.assert_array_equal(bits, want)
+
+    def test_always_rules_and_empty_lines(self):
+        from banjax_tpu.matcher.prefilter import FusedPrefilter
+
+        patterns = [r".*", r"^$", r"GET /wp-login\.php", r"/xmlrpc\.php",
+                    r"/\.env", r"(?i)sqlmap", r"POST /login[0-9]+"]
+        lines = ["", "GET x.com GET /wp-login.php -", "plain benign line",
+                 "POST a.b POST /login77 -", "SQLMAP probe"]
+        compiled, plan = self._plan(patterns)
+        assert plan is not None and plan.n_always >= 2
+        cls_ids, lens, he, want = self._oracle(compiled, plan, lines, 64)
+        fp = FusedPrefilter(plan, "xla")
+        bits = fp.match_bits_encoded(cls_ids, lens)
+        np.testing.assert_array_equal(bits, want)
+
+    def test_overflow_raises(self):
+        from banjax_tpu.matcher.prefilter import (
+            FusedPrefilter,
+            PrefilterOverflow,
+        )
+
+        patterns = [r"GET /wp-login\.php", r"/xmlrpc\.php", r"/\.env"]
+        compiled, plan = self._plan(patterns)
+        assert plan is not None
+        # every line matches → matched rows exceed E = K/4
+        lines = ["GET x GET /wp-login.php -"] * 256
+        cls_ids, lens, _, _ = self._oracle(compiled, plan, lines, 64)
+        fp = FusedPrefilter(plan, "xla", cand_frac=1.0)
+        with pytest.raises(PrefilterOverflow):
+            fp.match_bits_encoded(cls_ids, lens)
+
+    def test_submit_collect_pipeline(self):
+        from banjax_tpu.matcher.prefilter import FusedPrefilter
+
+        import bench
+
+        patterns = bench.generate_rules(40, seed=3)
+        compiled, plan = self._plan(patterns)
+        assert plan is not None
+        fp = FusedPrefilter(plan, "xla", cand_frac=1.0, out_frac=1.0)
+        batches = [
+            bench.generate_lines(100, patterns, seed=s, attack_rate=0.2)
+            for s in (1, 2, 3)
+        ]
+        encoded = [self._oracle(compiled, plan, b) for b in batches]
+        pending = [fp.submit(cls, lens) for cls, lens, _, _ in encoded]
+        for p, (_, _, _, want) in zip(pending, encoded):
+            np.testing.assert_array_equal(fp.collect(p), want)
+
+    def test_runner_overflow_falls_back_single_stage(self):
+        """TpuMatcher output is unchanged when the fused prefilter
+        overflows (adversarial all-matching traffic)."""
+        from banjax_tpu.config.schema import Config, RegexWithRate
+        from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+        from banjax_tpu.decisions.static_lists import StaticDecisionLists
+        from banjax_tpu.matcher.runner import TpuMatcher
+        from tests.mock_banner import MockBanner
+
+        rules = [
+            RegexWithRate.from_yaml_dict(
+                {"rule": f"r{i}", "regex": rx, "interval": 10,
+                 "hits_per_interval": 10**6, "decision": "nginx_block"}
+            )
+            for i, rx in enumerate(
+                [r"GET /wp-login\.php", r"/xmlrpc\.php", r"/\.env"]
+            )
+        ]
+        now = 1700000000.0
+        lines = [
+            f"{now} 1.2.3.{i % 16} GET x.com GET /wp-login.php HTTP/1.1"
+            for i in range(200)
+        ]
+
+        def run(prefilter):
+            cfg = Config(
+                regexes_with_rates=rules, matcher_backend="xla",
+                matcher_prefilter=prefilter, matcher_batch_lines=256,
+            )
+            m = TpuMatcher(
+                cfg, MockBanner(), StaticDecisionLists(cfg),
+                RegexRateLimitStates(),
+            )
+            if prefilter and m._prefilter is not None:
+                # force a tiny matched-row capacity so the batch overflows
+                m._prefilter.cand_frac = 1.0 / 64
+            return m.consume_lines(lines, now_unix=now)
+
+        with_pf, without_pf = run(True), run(False)
+        for a, b in zip(with_pf, without_pf):
+            assert [r.rule_name for r in a.rule_results] == [
+                r.rule_name for r in b.rule_results
+            ]
