@@ -28,8 +28,13 @@ to 0 (not 1) on exceed, FirstTime/OutsideInterval/InsideInterval match
 types, and seen_ip = "the IP had any state before this event".
 
 IP slots are assigned host-side (dict + LRU); evicting a slot queues a
-device-side row clear that runs at the start of the next apply step, so the
-device never needs a host round-trip mid-batch.
+device-side row clear that runs in the next maintenance step, so the device
+never needs a host round-trip mid-batch. Eviction is LOSSLESS: a host-side
+shadow (updated from each batch's event-final states, which the scan
+computes anyway) holds every (ip, rule) counter, and a re-admitted IP's
+rows are scattered back onto the device before its next events — beyond
+`matcher_window_capacity` distinct IPs the matcher degrades to slower,
+never to wrong (rate_limit.go:37-78 never forgets state, so neither do we).
 """
 
 from __future__ import annotations
@@ -120,20 +125,14 @@ def _apply_step(
     limits: jnp.ndarray,       # [R] int32 hits_per_interval
     iv_s: jnp.ndarray,         # [R] int32 interval seconds part
     iv_ns: jnp.ndarray,        # [R] int32 interval ns part
-    evict: jnp.ndarray,        # [K] int32 slots to clear first (-1 = none)
     *,
     n_rules: int,
     max_events: int,
 ):
+    # evictions/restores run in _maintenance_step BEFORE this step
     cap_r = state.hits.shape[0]
-
-    # 0. queued evictions: clear each evicted slot's rows + seen flag
-    ev_base = jnp.where(evict >= 0, evict * n_rules, cap_r)  # drop when -1
-    ev_keys = (ev_base[:, None] + jnp.arange(n_rules, dtype=jnp.int32)[None, :]).ravel()
-    valid = state.valid.at[ev_keys].set(False, mode="drop")
-    ip_seen = state.ip_seen.at[jnp.where(evict >= 0, evict, state.ip_seen.shape[0])].set(
-        False, mode="drop"
-    )
+    valid = state.valid
+    ip_seen = state.ip_seen
 
     fire = (bits != 0) & active_table[host_idx]
 
@@ -230,8 +229,40 @@ def _apply_step(
         "match_type": mtype,
         "exceeded": exceeded & ~pad_s,
         "seen_ip": seen_ip_s,
+        # per-event FINAL counter state: feeds the host shadow that makes
+        # eviction lossless (last event per key carries the written state)
+        "hits": f_hits,
+        "start_s": f_ss,
+        "start_ns": f_sns,
     }
     return new_state, out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _maintenance_step(
+    state: DeviceWindowState,
+    ev_keys: jnp.ndarray,   # [Ke] int32 flat keys to invalidate (cap_r = none)
+    ev_slots: jnp.ndarray,  # [K] int32 slots to clear seen flag (cap = none)
+    r_keys: jnp.ndarray,    # [Kr] int32 flat keys to restore (cap_r = none)
+    r_hits: jnp.ndarray,    # [Kr] int32
+    r_ss: jnp.ndarray,      # [Kr] int32
+    r_sns: jnp.ndarray,     # [Kr] int32
+    r_slots: jnp.ndarray,   # [K2] int32 slots to mark seen (cap = none)
+):
+    """Evictions THEN restores, in one dispatch: a slot can be evicted and
+    immediately reassigned+restored between two apply steps, so the order
+    within this step is what keeps the restored state from being cleared."""
+    valid = state.valid.at[ev_keys].set(False, mode="drop")
+    ip_seen = state.ip_seen.at[ev_slots].set(False, mode="drop")
+    hits = state.hits.at[r_keys].set(r_hits, mode="drop")
+    start_s = state.start_s.at[r_keys].set(r_ss, mode="drop")
+    start_ns = state.start_ns.at[r_keys].set(r_sns, mode="drop")
+    valid = valid.at[r_keys].set(True, mode="drop")
+    ip_seen = ip_seen.at[r_slots].set(True, mode="drop")
+    return DeviceWindowState(
+        hits=hits, start_s=start_s, start_ns=start_ns, valid=valid,
+        ip_seen=ip_seen,
+    )
 
 
 jax.tree_util.register_dataclass(
@@ -289,17 +320,25 @@ class DeviceWindows:
         self._slot_ip: Dict[int, str] = {}
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         self._pending_evict: List[int] = []
+        self._pending_restore: List[Tuple[int, str]] = []
         # slots handed out by slots_for_ips stay pinned until the matching
         # apply_bitmap consumes them, so a second caller's allocation can
         # never evict a slot whose events are still in flight
         self._pin_counts: Dict[int, int] = {}
-        # forget-on-evict: evicting a slot discards that IP's counters (the
-        # reference never forgets); this counter surfaces capacity pressure
+        # spill-on-evict: the host shadow below keeps every counter, so
+        # eviction only costs performance (a restore on re-admission), never
+        # correctness; this counter surfaces the capacity pressure
         self.eviction_count = 0
-        # insertion-order bookkeeping for byte-identical introspection: the
-        # host dict (rate_limit.go) orders IPs by first event and rules by
-        # first event per IP; FIRST_TIME events replay that order here
-        self._insertion: "OrderedDict[int, List[int]]" = OrderedDict()
+        # Host shadow of the device counters: ip → (rule_id → (hits, s, ns)),
+        # both dicts in first-event insertion order — exactly the reference
+        # host dict's shape (rate_limit.go:37-78, which never forgets).
+        # Updated from every batch's event-final states (the scan computes
+        # them anyway for the device write-back), so it costs O(events) host
+        # work per batch, not a device pull. It is the authoritative source
+        # for introspection (get/format_states/__len__) and the restore
+        # source when an evicted IP is re-admitted. Memory is O(distinct
+        # (ip, rule) pairs with events) — the reference's own asymptotic.
+        self._shadow: "Dict[str, OrderedDict]" = {}
         self._state = self._fresh_state()
 
     def _fresh_state(self) -> DeviceWindowState:
@@ -363,20 +402,26 @@ class DeviceWindows:
                     old_slot = self._slots.pop(victim_ip)
                     self._pending_evict.append(old_slot)
                     self._free.append(old_slot)
-                    self._insertion.pop(old_slot, None)
                     self._slot_ip.pop(old_slot, None)
                     if self.eviction_count == 0:
                         import logging
 
                         logging.getLogger(__name__).warning(
                             "device-windows capacity (%d slots) exceeded; "
-                            "evicting LRU IP state (counters forgotten — "
-                            "raise matcher_window_capacity)", self.capacity,
+                            "evicting LRU IP state to the host shadow "
+                            "(restored on re-admission — raise "
+                            "matcher_window_capacity to avoid the churn)",
+                            self.capacity,
                         )
                     self.eviction_count += 1
                 slot = self._free.pop()
                 self._slots[ip] = slot
                 self._slot_ip[slot] = ip
+                if ip in self._shadow:
+                    # previously-evicted IP returns: its counters re-enter
+                    # the device in the next maintenance step, BEFORE any
+                    # of this batch's events for it are applied
+                    self._pending_restore.append((slot, ip))
                 pinned.add(slot)
                 out[i] = slot
             for slot in set(out.tolist()):
@@ -404,16 +449,18 @@ class DeviceWindows:
         with self._lock:
             self._slots.clear()
             self._slot_ip.clear()
-            self._insertion.clear()
+            self._shadow.clear()
             self._free = list(range(self.capacity - 1, -1, -1))
             self._pending_evict = []
+            self._pending_restore = []
             self._pin_counts.clear()
             self._state = self._fresh_state()
 
     def __len__(self) -> int:
-        # parity with RegexRateLimitStates.__len__: IPs with any state
+        # parity with RegexRateLimitStates.__len__: IPs with any state —
+        # including evicted ones (the reference never forgets)
         with self._lock:
-            return len(self._insertion)
+            return len(self._shadow)
 
     # ---- the batch step ----
 
@@ -474,14 +521,7 @@ class DeviceWindows:
             return ev1 + ev2
 
         with self._lock:
-            pend = self._pending_evict
-            self._pending_evict = []
-            k = 256
-            while k < len(pend):
-                k <<= 1
-            evict = np.full((k,), -1, dtype=np.int32)
-            evict[: len(pend)] = pend
-
+            self._run_maintenance_locked()
             new_state, out = _apply_step(
                 self._state,
                 bits,
@@ -493,7 +533,6 @@ class DeviceWindows:
                 self._limits,
                 self._iv_s,
                 self._iv_ns,
-                jnp.asarray(evict),
                 n_rules=self.n_rules,
                 max_events=self.max_events,
             )
@@ -504,6 +543,10 @@ class DeviceWindows:
         mtype = np.asarray(out["match_type"])
         exceeded = np.asarray(out["exceeded"])
         seen = np.asarray(out["seen_ip"])
+        f_hits = np.asarray(out["hits"])
+        f_ss = np.asarray(out["start_s"])
+        f_sns = np.asarray(out["start_ns"])
+        live = np.flatnonzero(rule >= 0)
         events = [
             WindowEvent(
                 line=int(line[k]),
@@ -512,77 +555,116 @@ class DeviceWindows:
                 exceeded=bool(exceeded[k]),
                 seen_ip=bool(seen[k]),
             )
-            for k in np.flatnonzero(rule >= 0)
+            for k in live
         ]
+        with self._lock:
+            # shadow update: events arrive key-sorted with chronological
+            # ties, so overwriting in array order leaves each (ip, rule) at
+            # its segment-final state — exactly what was written on device.
+            # setdefault keeps first-event insertion order (reference dict).
+            for k in live:
+                ip = self._slot_ip.get(int(slot_ids[int(line[k])]))
+                if ip is None:  # unreachable while the batch is pinned
+                    continue
+                od = self._shadow.setdefault(ip, OrderedDict())
+                od[int(rule[k])] = (int(f_hits[k]), int(f_ss[k]), int(f_sns[k]))
         # reference order: by (line, rule_id) — per-site ids precede global
         events.sort(key=lambda e: (e.line, e.rule_id))
-        with self._lock:
-            for e in events:
-                if e.match_type is RateLimitMatchType.FIRST_TIME:
-                    slot = int(slot_ids[e.line])
-                    lst = self._insertion.setdefault(slot, [])
-                    if e.rule_id not in lst:
-                        lst.append(e.rule_id)
         return events
 
-    # ---- introspection parity with RegexRateLimitStates ----
+    def _run_maintenance_locked(self) -> None:
+        """Drain queued evictions + restores into the device state (caller
+        holds the lock). Sizes bucket to powers of two so the jit cache
+        stays bounded; padded entries scatter out of range and drop."""
+        if not self._pending_evict and not self._pending_restore:
+            return
+        cap_r = self.capacity * self.n_rules
+        pend_ev = self._pending_evict
+        pend_rs = self._pending_restore
+        self._pending_evict = []
+        self._pending_restore = []
 
-    def _slot_states(
-        self, slot: int, rule_order: Sequence[int], host
-    ) -> Dict[str, NumHitsAndIntervalStart]:
-        """Decode one slot's valid (rule → state) dict from host arrays."""
-        hits, ss, sns, valid = host
-        base = slot * self.n_rules
-        out: Dict[str, NumHitsAndIntervalStart] = {}
-        for i in rule_order:
-            if valid[base + i]:
-                out[self._rule_names[i]] = NumHitsAndIntervalStart(
-                    int(hits[base + i]),
-                    int(ss[base + i]) * _NS_PER_S + int(sns[base + i]),
-                )
-        return out
-
-    def _pull_host(self, state: DeviceWindowState):
-        """One transfer per array (not per IP) for the introspection APIs."""
-        return (
-            np.asarray(state.hits), np.asarray(state.start_s),
-            np.asarray(state.start_ns), np.asarray(state.valid),
+        ev_keys_np = (
+            (np.asarray(pend_ev, dtype=np.int64)[:, None] * self.n_rules
+             + np.arange(self.n_rules, dtype=np.int64)[None, :]).ravel()
+            .astype(np.int32)
+            if pend_ev else np.empty(0, dtype=np.int32)
         )
+        ev_slots_np = np.asarray(pend_ev, dtype=np.int32)
+        r_keys: List[int] = []
+        r_hits: List[int] = []
+        r_ss: List[int] = []
+        r_sns: List[int] = []
+        r_slots: List[int] = []
+        for slot, ip in pend_rs:
+            if self._slot_ip.get(slot) != ip:
+                # stale restore: the slot was re-evicted (and possibly
+                # reassigned to a DIFFERENT ip) after this restore was
+                # queued — scattering the old ip's counters now would
+                # resurrect them into the new owner's rows
+                continue
+            od = self._shadow.get(ip)
+            if not od:
+                continue
+            r_slots.append(slot)
+            base = slot * self.n_rules
+            for rid, (h, s, ns) in od.items():
+                r_keys.append(base + rid)
+                r_hits.append(h)
+                r_ss.append(s)
+                r_sns.append(ns)
+
+        def _pad(vals, fill, k):
+            arr = np.full((k,), fill, dtype=np.int32)
+            arr[: len(vals)] = vals
+            return jnp.asarray(arr)
+
+        kk = 256  # pow2 bucket: bounded jit-cache, padded entries drop
+        while kk < max(len(ev_keys_np), len(r_keys)):
+            kk <<= 1
+        ks = 256
+        while ks < max(len(ev_slots_np), len(r_slots)):
+            ks <<= 1
+        self._state = _maintenance_step(
+            self._state,
+            _pad(ev_keys_np, cap_r, kk),
+            _pad(ev_slots_np, self.capacity, ks),
+            _pad(r_keys, cap_r, kk),
+            _pad(r_hits, 0, kk),
+            _pad(r_ss, 0, kk),
+            _pad(r_sns, 0, kk),
+            _pad(r_slots, self.capacity, ks),
+        )
+
+    # ---- introspection parity with RegexRateLimitStates ----
+    # The host shadow (updated from every batch's event-final states) is the
+    # authoritative introspection source: no device pull, and it includes
+    # evicted IPs — the reference host dict never forgets, so neither do we.
 
     def get(self, ip: str) -> Tuple[Dict[str, NumHitsAndIntervalStart], bool]:
         with self._lock:
-            slot = self._slots.get(ip)
-            if slot is None or slot not in self._insertion:
+            od = self._shadow.get(ip)
+            if not od:
                 return {}, False  # seen at parse time but no event yet
-            rule_order = list(self._insertion[slot])
-            state = self._state
-        base = slot * self.n_rules
-        sl = slice(base, base + self.n_rules)
-        host = (
-            np.asarray(state.hits[sl]), np.asarray(state.start_s[sl]),
-            np.asarray(state.start_ns[sl]), np.asarray(state.valid[sl]),
-        )
-        return self._slot_states(0, rule_order, host), True
+            return {
+                self._rule_names[rid]: NumHitsAndIntervalStart(
+                    h, s * _NS_PER_S + ns
+                )
+                for rid, (h, s, ns) in od.items()
+            }, True
 
     def format_states(self) -> str:
         with self._lock:
-            rows = [
-                (slot, self._slot_ip[slot], list(order))
-                for slot, order in self._insertion.items()
-                if slot in self._slot_ip
-            ]
-            state = self._state
+            rows = [(ip, list(od.items())) for ip, od in self._shadow.items()]
         if not rows:
             return ""
-        host = self._pull_host(state)
         lines: List[str] = []
-        for slot, ip, rule_order in rows:
-            states = self._slot_states(slot, rule_order, host)
+        for ip, states in rows:
             lines.append(f"{ip}:")
-            for rule, s in states.items():
-                lines.append(f"\t{rule}:")
+            for rid, (h, s, ns) in states:
+                lines.append(f"\t{self._rule_names[rid]}:")
                 lines.append(
-                    f"\t\tNumHitsAndIntervalStart({s.num_hits}, {s.interval_start_time_ns})"
+                    f"\t\tNumHitsAndIntervalStart({h}, {s * _NS_PER_S + ns})"
                 )
             lines.append("")
         return "\n".join(lines) + ("\n" if lines else "")

@@ -1,16 +1,29 @@
 """Benchmark: log-lines/sec classified against 1k regex rules (BASELINE.json).
 
-Measures the device half of the TPU matcher — the batched NFA match that
-replaces the reference's serial per-(line, rule) regexp loop
-(/root/reference/internal/regex_rate_limiter.go:216-269) — on whatever
-accelerator is attached (the real TPU chip under the driver; CPU otherwise),
-plus the end-to-end TpuMatcher consume_lines path for context.
+Measures, on whatever accelerator is attached (the real TPU chip under the
+driver; CPU otherwise), the replacement for the reference's serial
+per-(line, rule) regexp loop (/root/reference/internal/regex_rate_limiter.go:216-269):
+
+  * the single-stage Pallas NFA kernel (device-resident, chained) and the
+    XLA-scan fallback — the raw device classification rate;
+  * the fused two-stage prefilter (matcher/prefilter.py FusedPrefilter),
+    pipelined through submit/collect — the rate INCLUDING host<->device
+    transport, which on the tunneled chip costs ~65 ms fixed per
+    device→host pull and must be overlapped to matter;
+  * the end-to-end TpuMatcher consume_lines path (native C parse + encode
+    + fused match + device windows + Banner), with per-batch latency
+    p50/p99 — the production numbers BASELINE.md names;
+  * the five-config BASELINE.json ladder (tests/perf shapes).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "lines/sec", "vs_baseline": N / 5e6}
+  {"metric": ..., "value": N, "unit": "lines/sec", "vs_baseline": N / 5e6, ...}
 vs_baseline is against the BASELINE.md north-star target of 5M lines/sec
 @1k rules on v5e-1 (the reference itself publishes no numbers — see
 BASELINE.md; its serial Go loop is the functional, not numerical, baseline).
+
+Env knobs: BENCH_CPU=1 forces the host backend; BENCH_NO_LADDER=1 skips the
+ladder; BENCH_BUDGET_S caps wall time (default 480 s) — sections past the
+deadline are skipped and marked, so the driver always gets its JSON line.
 """
 
 from __future__ import annotations
@@ -26,29 +39,28 @@ import numpy as np
 
 
 N_RULES = 1000
-BATCH = 8192
 MAX_LEN = 128
 WARMUP = 3
 ITERS = 10
 
-BACKEND_PROBE_TIMEOUT_S = 150
-BACKEND_PROBE_RETRIES = 2
+# A hung axon init can wedge on the terminal side; killing a client
+# mid-device-op can ALSO wedge the terminal session for later clients
+# (observed r3: a timeout-killed Mosaic compile left jax.devices() hanging
+# for every subsequent process). So: probe in a subprocess with a GENEROUS
+# timeout, retry with long backoff, and fall back to CPU rather than kill
+# aggressively.
+BACKEND_PROBE_TIMEOUT_S = 240
+BACKEND_PROBE_RETRIES = 3
 
 
 def _probe_backend() -> "tuple[str, str | None]":
-    """Decide the backend before jax initializes in this process.
-
-    TPU-tunnel init can hang indefinitely rather than raise, so the probe
-    runs `jax.devices()` in a subprocess under a timeout, with retry +
-    backoff. On repeated failure the bench falls back to host CPU so the
-    driver still gets its one JSON line, with the failure recorded in
-    "backend_error"."""
+    """Decide the backend before jax initializes in this process."""
     if os.environ.get("BENCH_CPU"):
         return "cpu", None
     err = None
     for attempt in range(BACKEND_PROBE_RETRIES):
         if attempt:
-            time.sleep(5 * attempt)
+            time.sleep(30 * attempt)
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
@@ -61,7 +73,7 @@ def _probe_backend() -> "tuple[str, str | None]":
             err = f"probe rc={r.returncode}: {r.stderr.strip()[-300:]}"
         except subprocess.TimeoutExpired:
             err = (f"probe timeout after {BACKEND_PROBE_TIMEOUT_S}s "
-                   "(backend init hang)")
+                   "(backend init hang — terminal session likely wedged)")
     return "cpu", err
 
 
@@ -163,7 +175,21 @@ def _time_chained(step, args, batch):
     return batch * ITERS / elapsed, elapsed / ITERS, first_call_s
 
 
-def run_bench(jax) -> dict:
+class _Deadline:
+    def __init__(self, budget_s: float):
+        self.t0 = time.monotonic()
+        self.budget = budget_s
+        self.skipped: list = []
+
+    def over(self, section: str) -> bool:
+        if time.monotonic() - self.t0 > self.budget:
+            self.skipped.append(section)
+            return True
+        return False
+
+
+def _bench_single_stage(jax, patterns, backend, batch, deadline, out):
+    """Single-stage device NFA classification (the r1/r2 headline path)."""
     import jax.numpy as jnp
 
     from banjax_tpu.matcher import nfa_jax
@@ -171,144 +197,172 @@ def run_bench(jax) -> dict:
     from banjax_tpu.matcher.kernels import nfa_match
     from banjax_tpu.matcher.rulec import compile_rules
 
-    backend = jax.devices()[0].platform
-    patterns = generate_rules(N_RULES)
-
     t0 = time.perf_counter()
-    compiled = compile_rules(patterns)
-    compiled_sharded = compile_rules(patterns, n_shards="auto")
-    compile_s = time.perf_counter() - t0
-    n_device = int(compiled.device_ok.sum())
+    compiled = compile_rules(patterns, n_shards="auto")
+    out["rule_compile_s"] = round(time.perf_counter() - t0, 2)
+    out["rules_on_device"] = int(compiled.device_ok.sum())
+    out["nfa_words"] = compiled.n_words
+    out["nfa_shards"] = compiled.n_shards
 
-    lines = generate_lines(BATCH, patterns)
-    cls_ids, lens, host_eval = encode_for_match(compiled_sharded, lines, MAX_LEN)
+    lines = generate_lines(batch, patterns)
+    cls_ids, lens, host_eval = encode_for_match(compiled, lines, MAX_LEN)
     assert not host_eval.any()
-    # sort by length and trim the scan to the batch max, exactly as
-    # match_batch_pallas does internally for the production runner path
     order = np.argsort(lens, kind="stable")
     cls_ids, lens = cls_ids[order], lens[order]
-    lines = [lines[i] for i in order]  # keep the raw lines aligned
-    L_p = max(8, -(-int(lens.max()) // 32) * 32)
+    L_p = max(32, -(-int(lens.max()) // 32) * 32)
     cls_ids = np.ascontiguousarray(cls_ids[:, :L_p])
     lens_dev = jax.device_put(lens)
 
-    # --- Pallas kernel path (the flagship): one-hot MXU gather + VPU
-    # shift-and, state resident in VMEM (matcher/kernels/nfa_match.py).
-    # Off-TPU the kernel only runs in interpret mode, far too slow to time
-    # at this batch size — the XLA path carries the off-TPU number and a
-    # small interpret-mode slice keeps the parity check.
-    pallas_ok = backend == "tpu"
-    interpret = False
-    prep = None
-    try:
-        prep = nfa_match.prepare(compiled_sharded)
-        if not pallas_ok:
-            raise nfa_match.PallasUnsupported("non-TPU backend: interpret-only")
-        dev_fn = nfa_match.device_matcher(prep, BATCH, L_p,
-                                          interpret=interpret)
-        cls_t_dev = jax.device_put(np.ascontiguousarray(cls_ids.T))
-
-        @jax.jit
-        def chained_pallas(s, cls_t, ln):
-            out = dev_fn(cls_t, ln)
-            return s + out.astype(jnp.int32).sum()
-
-        pallas_lps, pallas_lat, pallas_first = _time_chained(
-            chained_pallas, (cls_t_dev, lens_dev), BATCH
-        )
-    except nfa_match.PallasUnsupported:
-        pallas_ok = False
-
-    # --- XLA scan path (the fallback backend), for comparison
-    params = nfa_jax.match_params(compiled_sharded)
+    params = nfa_jax.match_params(compiled)
     cls_dev = jax.device_put(cls_ids)
 
     @jax.jit
     def chained_xla(s, cls, ln):
-        out = nfa_jax.match_batch(params, cls, ln, compiled_sharded.n_rules)
-        return s + out.astype(jnp.int32).sum()
+        o = nfa_jax.match_batch(params, cls, ln, compiled.n_rules)
+        return s + o.astype(jnp.int32).sum()
 
     xla_lps, xla_lat, xla_first = _time_chained(
-        chained_xla, (cls_dev, lens_dev), BATCH
+        chained_xla, (cls_dev, lens_dev), batch
+    )
+    out["xla_lines_per_sec"] = round(xla_lps, 1)
+
+    want = np.asarray(
+        nfa_jax.match_batch(params, cls_dev, lens_dev, compiled.n_rules)
+    )
+    out["line_match_rate"] = round(float(want.any(axis=1).mean()), 4)
+
+    pallas_lps = None
+    if backend == "tpu" and not deadline.over("pallas_single_stage"):
+        prep = nfa_match.prepare(compiled)
+        dev_fn = nfa_match.device_matcher(prep, batch, L_p, 512, cols=32)
+        cls_t_dev = jax.device_put(np.ascontiguousarray(cls_ids.T))
+
+        @jax.jit
+        def chained_pallas(s, cls_t, ln):
+            o = dev_fn(cls_t, ln)
+            return s + o.astype(jnp.int32).sum()
+
+        pallas_lps, pallas_lat, pallas_first = _time_chained(
+            chained_pallas, (cls_t_dev, lens_dev), batch
+        )
+        out["pallas_lines_per_sec"] = round(pallas_lps, 1)
+        out["pallas_batch_latency_ms"] = round(pallas_lat * 1e3, 3)
+        out["first_call_s"] = round(pallas_first, 2)
+        got = nfa_match.match_batch_pallas(prep, cls_ids, lens, cols=32)
+        assert (got == want).all(), "pallas/XLA match bitmap divergence"
+    else:
+        out["pallas_lines_per_sec"] = None
+        out["first_call_s"] = round(xla_first, 2)
+
+    return compiled, lines, cls_ids, lens, want, order, pallas_lps, xla_lps
+
+
+def _bench_fused(jax, patterns, compiled, backend, batch, want_sorted, out):
+    """Fused two-stage prefilter, pipelined: classification rate INCLUDING
+    the host<->device transport and sparse-result decode."""
+    from banjax_tpu.matcher.encode import encode_for_match
+    from banjax_tpu.matcher.prefilter import FusedPrefilter, build_plan
+
+    plan = build_plan(
+        patterns, byte_classes=(compiled.byte_to_class, compiled.n_classes)
+    )
+    if plan is None:
+        return None
+    out["prefilter_stage1_words"] = plan.stage1.n_words
+    out["prefilter_stage2_words"] = plan.stage2.n_words
+    fp = FusedPrefilter(plan, "pallas" if backend == "tpu" else "xla")
+
+    lines = generate_lines(batch, patterns, seed=23)
+    cls_ids, lens, _ = encode_for_match(compiled, lines, MAX_LEN)
+    bits = fp.match_bits_encoded(cls_ids, lens)  # compile + parity data
+    # parity vs the single-stage oracle on this batch
+    from banjax_tpu.matcher import nfa_jax
+
+    params = nfa_jax.match_params(compiled)
+    want = np.asarray(
+        nfa_jax.match_batch(
+            params, jax.device_put(cls_ids), jax.device_put(lens),
+            compiled.n_rules,
+        )
+    )
+    for rid in plan.unsupported:
+        want[:, rid] = 0
+    assert (bits == want).all(), "fused/single-stage divergence"
+    out["prefilter_candidate_fraction"] = round(
+        float(want.any(axis=1).mean()), 4
     )
 
-    out = np.asarray(
-        nfa_jax.match_batch(params, cls_dev, lens_dev, compiled_sharded.n_rules)
-    )
-    match_rate = float(out.any(axis=1).mean())
-    if pallas_ok:
-        got = nfa_match.match_batch_pallas(prep, cls_ids, lens)
-        assert (got == out).all(), "pallas/XLA match bitmap divergence"
-    elif prep is not None:
-        n_check = 256  # interpret mode: parity on a slice, no timing
-        got = nfa_match.match_batch_pallas(
-            prep, cls_ids[:n_check], lens[:n_check], interpret=True
-        )
-        assert (got == out[:n_check]).all(), "pallas/XLA match bitmap divergence"
+    for _ in range(2):  # warm
+        fp.collect(fp.submit(cls_ids, lens))
+    n_iters = 8
+    t0 = time.perf_counter()
+    pend = fp.submit(cls_ids, lens)
+    for _ in range(n_iters - 1):
+        nxt = fp.submit(cls_ids, lens)
+        fp.collect(pend)
+        pend = nxt
+    fp.collect(pend)
+    elapsed = time.perf_counter() - t0
+    lps = batch * n_iters / elapsed
+    out["fused_pipelined_lines_per_sec"] = round(lps, 1)
+    out["fused_batch_latency_ms"] = round(elapsed / n_iters * 1e3, 3)
+    return lps
 
-    # --- two-stage literal prefilter (matcher/prefilter.py): END-TO-END
-    # host-side throughput — encode + stage-1 scan of every line + stage-2
-    # full NFA on candidate lines + bitmap merge, host orchestration
-    # included. This is what the production runner path does per batch.
-    from banjax_tpu.matcher.prefilter import PrefilterMatcher, build_plan
 
-    pf_lps = pf_lat = None
-    cand_frac = None
-    plan = build_plan(patterns)
-    if plan is not None:
-        pf = PrefilterMatcher(
-            plan, "pallas" if pallas_ok else "xla", MAX_LEN, max_batch=BATCH
-        )
-        bits_pf, he = pf.match_bits(lines)
-        want = out.copy()
-        for rid in plan.unsupported:
-            want[:, rid] = 0
-        assert (bits_pf == want).all(), "two-stage/single-stage divergence"
-        cand_frac = float(
-            np.count_nonzero(bits_pf[:, plan.f_idx].any(axis=1))
-        ) / BATCH  # lower bound on true candidate rate; reported for context
-        for _ in range(WARMUP):
-            pf.match_bits(lines)
-        t0 = time.perf_counter()
-        for _ in range(ITERS):
-            pf.match_bits(lines)
-        elapsed = time.perf_counter() - t0
-        pf_lps = BATCH * ITERS / elapsed
-        pf_lat = elapsed / ITERS
+def _bench_e2e(jax, patterns, backend, out):
+    """End-to-end consume_lines: native parse + encode + fused device match
+    + device windows + Banner replay. Reports throughput and the per-batch
+    latency distribution (p50/p99) — the p99 Decision latency proxy: a
+    line's decision lands at most one batch window behind its arrival."""
+    import yaml as _yaml
 
-    best_lps = max(pallas_lps, xla_lps) if pallas_ok else xla_lps
-    best_lat = min(pallas_lat, xla_lat) if pallas_ok else xla_lat
-    if pf_lps is not None and pf_lps > best_lps:
-        best_lps, best_lat = pf_lps, pf_lat
-    return {
-        "metric": "log-lines/sec classified @1k rules (device NFA match)",
-        "value": round(best_lps, 1),
-        "unit": "lines/sec",
-        "vs_baseline": round(best_lps / 5_000_000, 4),
-        "backend": backend,
-        "batch": BATCH,
-        "batch_latency_ms": round(best_lat * 1e3, 3),
-        "pallas_lines_per_sec": round(pallas_lps, 1) if pallas_ok else None,
-        "xla_lines_per_sec": round(xla_lps, 1),
-        "prefilter_e2e_lines_per_sec": round(pf_lps, 1) if pf_lps else None,
-        "prefilter_candidate_fraction": (
-            round(cand_frac, 4) if cand_frac is not None else None
-        ),
-        "prefilter_stage1_words": plan.stage1.n_words if plan else None,
-        "prefilter_stage2_words": plan.stage2.n_words if plan else None,
-        "rules_total": N_RULES,
-        "rules_on_device": n_device,
-        "nfa_words": compiled.n_words,
-        "nfa_shards": compiled_sharded.n_shards,
-        "rule_compile_s": round(compile_s, 2),
-        "first_call_s": round(pallas_first if pallas_ok else xla_first, 2),
-        "line_match_rate": round(match_rate, 4),
-    }
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from tests.mock_banner import MockBanner
+
+    batch = 16384 if backend == "tpu" else 2048
+    n_batches = 6 if backend == "tpu" else 3
+    rules_yaml = _yaml.safe_dump({
+        "regexes_with_rates": [
+            {"rule": f"crs{i}", "regex": p, "interval": 60,
+             "hits_per_interval": 50, "decision": "nginx_block"}
+            for i, p in enumerate(patterns)
+        ]
+    })
+    cfg = config_from_yaml_text(rules_yaml)
+    cfg.matcher_batch_lines = batch
+    cfg.matcher_device_windows = True
+    banner = MockBanner()
+    m = TpuMatcher(cfg, banner, StaticDecisionLists(cfg), RegexRateLimitStates())
+
+    now = time.time()
+    rests = generate_lines(batch, patterns, seed=31)
+    lines = [
+        f"{now:.6f} 10.{i % 64}.{(i >> 6) % 256}.{(i >> 14) % 256} {r}"
+        for i, r in enumerate(rests)
+    ]
+    m.consume_lines(lines[:256], now)  # warm compile
+    m.consume_lines(lines, now)
+    lats = []
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        tb = time.perf_counter()
+        m.consume_lines(lines, now)
+        lats.append(time.perf_counter() - tb)
+    elapsed = time.perf_counter() - t0
+    lats.sort()
+    out["e2e_lines_per_sec"] = round(batch * n_batches / elapsed, 1)
+    out["e2e_batch"] = batch
+    out["e2e_batch_latency_ms_p50"] = round(lats[len(lats) // 2] * 1e3, 2)
+    out["e2e_batch_latency_ms_p99"] = round(lats[-1] * 1e3, 2)
+    out["e2e_staleness_budget_used"] = round(lats[-1] / 10.0, 4)  # of the 10 s drop window
 
 
 def run_ladder() -> dict:
-    """BENCH_LADDER=1: run the five BASELINE.json configs (tests/perf
-    shapes) on the attached backend and fold their numbers into the JSON."""
+    """The five BASELINE.json configs (tests/perf shapes) on the attached
+    backend; one config failing keeps the rest."""
     import io
     from contextlib import redirect_stdout
 
@@ -330,8 +384,6 @@ def run_ladder() -> dict:
                 buf.getvalue().strip().splitlines()[-1]
             )["lines_per_sec"]
         except Exception as exc:  # noqa: BLE001 — one config failing keeps the rest
-            # keep the measured number if the JSON line printed before the
-            # failure (e.g. a floor assertion on a loaded host)
             measured = None
             for line in reversed(buf.getvalue().strip().splitlines()):
                 try:
@@ -346,8 +398,46 @@ def run_ladder() -> dict:
     return out
 
 
+def run_bench(jax, deadline) -> dict:
+    backend = jax.devices()[0].platform
+    batch = 32768 if backend == "tpu" else 8192
+    out: dict = {"backend": backend, "batch": batch}
+    patterns = generate_rules(N_RULES)
+
+    (compiled, _lines, cls_sorted, lens_sorted, want_sorted, _order,
+     pallas_lps, xla_lps) = _bench_single_stage(
+        jax, patterns, backend, batch, deadline, out
+    )
+
+    fused_lps = None
+    if not deadline.over("fused_prefilter"):
+        fused_lps = _bench_fused(
+            jax, patterns, compiled, backend, batch, want_sorted, out
+        )
+
+    if not deadline.over("e2e_consume_lines"):
+        _bench_e2e(jax, patterns, backend, out)
+
+    if not os.environ.get("BENCH_NO_LADDER") and not deadline.over("ladder"):
+        out["ladder"] = run_ladder()
+
+    candidates = [v for v in (pallas_lps, xla_lps, fused_lps) if v]
+    best = max(candidates)
+    out["value"] = round(best, 1)
+    out["vs_baseline"] = round(best / 5_000_000, 4)
+    out["metric"] = "log-lines/sec classified @1k rules (device NFA match)"
+    out["unit"] = "lines/sec"
+    out["batch_latency_ms"] = out.get(
+        "pallas_batch_latency_ms", out.get("fused_batch_latency_ms")
+    )
+    if deadline.skipped:
+        out["sections_skipped_on_budget"] = deadline.skipped
+    return out
+
+
 def main() -> None:
     requested, backend_error = _probe_backend()
+    deadline = _Deadline(float(os.environ.get("BENCH_BUDGET_S", "480")))
 
     result: dict
     try:
@@ -357,9 +447,7 @@ def main() -> None:
             # the axon sitecustomize pins jax_platforms to the TPU tunnel;
             # the config knob (not the env var) is what actually overrides it
             jax.config.update("jax_platforms", "cpu")
-        result = run_bench(jax)
-        if os.environ.get("BENCH_LADDER"):
-            result["ladder"] = run_ladder()
+        result = run_bench(jax, deadline)
     except Exception as exc:  # always emit the one JSON line, never a traceback
         result = {
             "metric": "log-lines/sec classified @1k rules (device NFA match)",
@@ -370,7 +458,11 @@ def main() -> None:
         }
     if backend_error:
         result["backend_error"] = backend_error
-    print(json.dumps(result))
+    # key order: metric/value first for human eyeballs
+    head = ["metric", "value", "unit", "vs_baseline", "backend"]
+    ordered = {k: result[k] for k in head if k in result}
+    ordered.update({k: v for k, v in result.items() if k not in ordered})
+    print(json.dumps(ordered))
 
 
 if __name__ == "__main__":
