@@ -68,12 +68,39 @@ class PrefilterPlan:
     unsupported: Dict[int, str]  # rule id -> reason (host regex fallback)
 
 
+def gate_masks(plan: "PrefilterPlan", prep=None):
+    """Stage-1 gate arrays over the RAW accept words: (fmask [W1] uint32 —
+    OR of all factor branches' accept bits; a_word/a_mask/a_rule — the
+    always-rule branches' extraction triple). With `prep` (a PallasRules),
+    word indices live in the kernel's padded word space. Shared by the
+    single-device FusedPrefilter and the mesh fused path."""
+    s1 = plan.stage1
+    if prep is not None:
+        w1 = prep.total_words
+        acc_word = np.asarray(prep.acc_word)
+    else:
+        w1 = s1.n_words
+        acc_word = np.asarray(s1.acc_word)
+    acc_mask = np.asarray(s1.acc_mask, dtype=np.uint32)
+    branch_rule = np.asarray(s1.branch_rule)
+    fac = branch_rule >= plan.n_always
+    fmask = np.zeros(w1, dtype=np.uint32)
+    np.bitwise_or.at(fmask, acc_word[fac], acc_mask[fac])
+    return (
+        fmask,
+        acc_word[~fac].astype(np.int32),
+        acc_mask[~fac],
+        branch_rule[~fac].astype(np.int32),
+    )
+
+
 def build_plan(
     patterns: Sequence[str],
     min_factor_len: int = 3,
     max_factor_len: int = 12,
     min_filterable_fraction: float = 0.5,
     byte_classes=None,
+    stage2_shards="auto",
 ) -> Optional[PrefilterPlan]:
     """Split `patterns` into the two-stage plan, or None when the ruleset
     doesn't profit (too few filterable rules — the two-pass overhead would
@@ -124,7 +151,10 @@ def build_plan(
     stage1_programs = [programs[i] for i in always_ids] + factor_progs
     stage2_programs = [programs[i] for i in filt_ids]
     s1 = pack_programs(stage1_programs, n_shards="auto", byte_classes=byte_classes)
-    s2 = pack_programs(stage2_programs, n_shards="auto", byte_classes=byte_classes)
+    # stage2_shards=rp pins the word slabs to a mesh's rule-parallel axis
+    s2 = pack_programs(
+        stage2_programs, n_shards=stage2_shards, byte_classes=byte_classes
+    )
     log.info(
         "prefilter plan: %d always + %d filterable rules, %d distinct factors; "
         "stage1 %d words, stage2 %d words",
@@ -314,22 +344,14 @@ class FusedPrefilter:
         # "any factor hit" bit needs no branch extraction at all (the
         # [B, n_branches] gather costs more than the stage-1 scan itself).
         s1 = plan.stage1
-        if self._pallas:
-            w1 = self._preps["s1"].total_words
-            acc_word = np.asarray(self._preps["s1"].acc_word)
-        else:
-            w1 = s1.n_words
-            acc_word = np.asarray(s1.acc_word)
-        acc_mask = np.asarray(s1.acc_mask, dtype=np.uint32)
-        branch_rule = np.asarray(s1.branch_rule)
-        fmask = np.zeros(w1, dtype=np.uint32)
-        fac = branch_rule >= plan.n_always
-        np.bitwise_or.at(fmask, acc_word[fac], acc_mask[fac])
+        fmask, a_word, a_mask, a_rule = gate_masks(
+            plan, self._preps["s1"] if self._pallas else None
+        )
         self._fmask = jnp.asarray(fmask)
         # always-rule extraction (usually a handful of branches)
-        self._a_word = jnp.asarray(acc_word[~fac], dtype=jnp.int32)
-        self._a_mask = jnp.asarray(acc_mask[~fac])
-        self._a_rule = jnp.asarray(branch_rule[~fac], dtype=jnp.int32)
+        self._a_word = jnp.asarray(a_word)
+        self._a_mask = jnp.asarray(a_mask)
+        self._a_rule = jnp.asarray(a_rule)
         # host-static flags for always-rules (applied after decode)
         self._a_always = np.asarray(s1.always_match[: plan.n_always], dtype=bool)
         self._a_empty = np.asarray(s1.empty_only[: plan.n_always], dtype=bool)

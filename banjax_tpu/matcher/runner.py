@@ -188,12 +188,33 @@ class TpuMatcher(Matcher):
                 mesh_backend = "pallas"
             else:
                 mesh_backend = "xla"
+
+            # fused two-stage under the mesh: stage 1 replicated, stage 2
+            # packed to exactly rp word slabs, shared byte classes with the
+            # full single-stage tensors (one encode feeds everything)
+            mesh_plan = None
+            if getattr(config, "matcher_prefilter", True):
+                from banjax_tpu.matcher.prefilter import build_plan
+
+                try:
+                    mesh_plan = build_plan(
+                        [r.regex_string for _, r in self._entries],
+                        byte_classes=(
+                            self.compiled.byte_to_class,
+                            self.compiled.n_classes,
+                        ),
+                        stage2_shards=self._mesh_rp,
+                    )
+                except Exception:  # noqa: BLE001 — plan bug must not kill the matcher
+                    log.exception("mesh prefilter plan failed; single-stage")
+
             # block granularity only matters for the compiled kernel; the
             # XLA/interpret bodies shouldn't pad every batch to dp*128 rows
             def _mk(backend):
                 return ShardedMatchBackend(
                     self.compiled, self._mesh, self._max_len, backend=backend,
                     block_b=128 if backend == "pallas" else 8,
+                    plan=mesh_plan,
                 )
 
             try:
@@ -204,9 +225,9 @@ class TpuMatcher(Matcher):
                 )
                 self._mesh_matcher = _mk("xla")
             log.info(
-                "matcher mesh: dp=%d rp=%d backend=%s",
+                "matcher mesh: dp=%d rp=%d backend=%s prefilter=%s",
                 self._mesh.shape["dp"], self._mesh_rp,
-                self._mesh_matcher.backend,
+                self._mesh_matcher.backend, mesh_plan is not None,
             )
 
         if want_pallas and self._mesh_matcher is None:
@@ -234,11 +255,6 @@ class TpuMatcher(Matcher):
         # this matcher's byte classes, so the native parse's encode feeds
         # it directly and the whole two-stage pipeline is one device call.
         self._prefilter = None
-        if self._mesh_matcher is not None and getattr(config, "matcher_prefilter", True):
-            log.info(
-                "prefilter not yet fused with the mesh path; running the "
-                "full sharded NFA per batch"
-            )
         if getattr(config, "matcher_prefilter", True) and self._mesh_matcher is None:
             from banjax_tpu.matcher.prefilter import FusedPrefilter, build_plan
 

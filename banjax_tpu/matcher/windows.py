@@ -538,26 +538,20 @@ class DeviceWindows:
             )
             self._state = new_state
 
-        line = np.asarray(out["line"])
-        rule = np.asarray(out["rule"])
-        mtype = np.asarray(out["match_type"])
-        exceeded = np.asarray(out["exceeded"])
-        seen = np.asarray(out["seen_ip"])
-        f_hits = np.asarray(out["hits"])
-        f_ss = np.asarray(out["start_s"])
-        f_sns = np.asarray(out["start_ns"])
-        live = np.flatnonzero(rule >= 0)
-        events = [
-            WindowEvent(
-                line=int(line[k]),
-                rule_id=int(rule[k]),
-                match_type=RateLimitMatchType(int(mtype[k])),
-                exceeded=bool(exceeded[k]),
-                seen_ip=bool(seen[k]),
-            )
-            for k in live
-        ]
-        with self._lock:
+            # The event pull AND the shadow update stay inside THIS lock
+            # window: with two concurrent batches, writing the shadow in a
+            # later acquisition could land the batches' final states in the
+            # opposite order of their device application, and an eviction
+            # would then restore the stale value as authoritative.
+            line = np.asarray(out["line"])
+            rule = np.asarray(out["rule"])
+            mtype = np.asarray(out["match_type"])
+            exceeded = np.asarray(out["exceeded"])
+            seen = np.asarray(out["seen_ip"])
+            f_hits = np.asarray(out["hits"])
+            f_ss = np.asarray(out["start_s"])
+            f_sns = np.asarray(out["start_ns"])
+            live = np.flatnonzero(rule >= 0)
             # shadow update: events arrive key-sorted with chronological
             # ties, so overwriting in array order leaves each (ip, rule) at
             # its segment-final state — exactly what was written on device.
@@ -568,6 +562,17 @@ class DeviceWindows:
                     continue
                 od = self._shadow.setdefault(ip, OrderedDict())
                 od[int(rule[k])] = (int(f_hits[k]), int(f_ss[k]), int(f_sns[k]))
+
+        events = [
+            WindowEvent(
+                line=int(line[k]),
+                rule_id=int(rule[k]),
+                match_type=RateLimitMatchType(int(mtype[k])),
+                exceeded=bool(exceeded[k]),
+                seen_ip=bool(seen[k]),
+            )
+            for k in live
+        ]
         # reference order: by (line, rule_id) — per-site ids precede global
         events.sort(key=lambda e: (e.line, e.rule_id))
         return events

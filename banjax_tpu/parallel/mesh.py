@@ -241,6 +241,174 @@ def sharded_pallas_fn(
     return jax.jit(fn)
 
 
+# ---- fused two-stage prefilter under the mesh ----
+#
+# Stage 1 (the narrow factor/always automaton) is REPLICATED: every device
+# scans its dp row's line shard against the whole stage-1 NFA — it is ~5x
+# narrower than the full ruleset, so replicating it costs less than any
+# resharding would. The candidate gate and compaction are dp-shard-local
+# (identical across the rp members of a row, so no collective is needed to
+# agree). Stage 2 (the full filterable-rule NFA) stays rp-sharded exactly
+# like the single-stage path and runs ONLY on the compacted candidates; the
+# one psum over rp of accept bits remains the only collective in the step.
+
+
+def sharded_fused_fn(
+    plan,                       # prefilter.PrefilterPlan (stage2 packed rp-sharded)
+    mesh: Mesh,
+    B: int,
+    L_p: int,
+    block_b: int,
+    backend: str,               # xla | pallas | pallas-interpret
+    cand_frac: float = 0.125,
+):
+    """Multi-device fused two-stage match step.
+
+    Returns (fn, params, K_local) where fn(params1, params2, cls, lens) →
+    (bits [B, n_rules] uint8 — always-rule static flags NOT yet applied,
+    n_cand [dp] int32 — per-shard candidate counts for the overflow check).
+    """
+    from banjax_tpu.matcher.prefilter import gate_masks
+
+    dp, rp = mesh.shape["dp"], mesh.shape["rp"]
+    if plan.stage2.n_shards != rp:
+        raise ValueError(
+            f"plan stage2 packed for {plan.stage2.n_shards} shards, mesh rp={rp}"
+        )
+    b_local = B // dp
+    block = min(block_b, b_local)
+    K = min(b_local, max(block, -(-int(b_local * cand_frac) // block) * block))
+    n_rules = plan.n_rules
+    n_filt = plan.stage2.n_rules
+    n_always = plan.n_always
+    a_idx = jnp.asarray(plan.a_idx, dtype=jnp.int32)
+    f_idx = jnp.asarray(plan.f_idx, dtype=jnp.int32)
+    pallas = backend in ("pallas", "pallas-interpret")
+    interpret = backend == "pallas-interpret"
+
+    if pallas:
+        prep1 = pallas_nfa.prepare(plan.stage1)
+        prep2 = pallas_nfa.prepare(plan.stage2)
+        fmask_np, a_word, a_mask, a_rule = gate_masks(plan, prep1)
+        wps2 = prep2.wps_p
+        cols = pallas_nfa._COLS_PER_STEP
+        # stage 1 may itself be packed into several shards ("auto"); the
+        # replicated body runs them as the kernel's shard grid axis
+        call1 = pallas_nfa._build_raw_call(
+            b_local, L_p, prep1.n_classes_p, prep1.n_shards, prep1.wps_p,
+            block, interpret
+        )
+        # stage 2: each rp member owns exactly one word slab → local ns=1
+        call2 = pallas_nfa._build_raw_call(
+            K, L_p, prep2.n_classes_p, 1, wps2, min(block, K), interpret
+        )
+        params1 = {"btab_t": prep1.btab_t, "masks_t": prep1.masks_t}
+        params2 = shard_pallas_params(prep2, mesh)
+    else:
+        fmask_np, a_word, a_mask, a_rule = gate_masks(plan)
+        wps2 = plan.stage2.words_per_shard
+        params1 = nfa_jax.match_params(plan.stage1)
+        params2 = shard_params(plan.stage2, mesh)
+    fmask = jnp.asarray(fmask_np)
+    a_word_j = jnp.asarray(a_word)
+    a_mask_j = jnp.asarray(a_mask)
+    a_rule_j = jnp.asarray(a_rule)
+
+    def _gate_and_compact(acc1, cls_rows_local, lens_local):
+        """acc1 [b, W1]; cls_rows_local [b, L_p] → candidate gather."""
+        cand = (acc1 & fmask[None, :]).max(axis=1) > 0
+        n_cand = jnp.sum(cand.astype(jnp.int32))
+        (idx,) = jnp.nonzero(cand, size=K, fill_value=0)
+        valid = jax.lax.iota(jnp.int32, K) < n_cand
+        cls2 = jnp.take(cls_rows_local, idx, axis=0)
+        lens2 = jnp.where(valid, jnp.take(lens_local, idx), 0)
+        return idx, valid, n_cand, cls2, lens2
+
+    def _always_bits(acc1):
+        """[b, n_always] uint8 from stage-1 accept words (dynamic part)."""
+        b = acc1.shape[0]
+        ab = jnp.zeros((b, max(1, n_always)), dtype=jnp.uint8)
+        if n_always and a_word_j.shape[0] > 0:
+            sel = (acc1[:, a_word_j] & a_mask_j) != 0  # [b, n_abr]
+            ab = ab.at[:, a_rule_j].max(sel.astype(jnp.uint8))
+        return ab
+
+    def _merge(idx, valid, m2, ab, b):
+        m2 = m2 & (valid[:, None] * jnp.uint8(0xFF))
+        filt = jnp.zeros((b, n_filt), dtype=jnp.uint8).at[idx].max(m2)
+        bits = jnp.zeros((b, n_rules), dtype=jnp.uint8)
+        if n_always:
+            bits = bits.at[:, a_idx].set(ab[:, :n_always])
+        bits = bits.at[:, f_idx].set(filt)
+        return bits
+
+    if pallas:
+
+        def local_step(p1, p2, cls_t_local, lens_local):
+            lens_row = lens_local[None, :]
+            maxtile1 = jnp.asarray(
+                -(-lens_local.reshape(b_local // block, block).max(axis=1)
+                  // cols),
+                dtype=jnp.int32,
+            )
+            acc1 = call1(
+                maxtile1, cls_t_local, lens_row, p1["btab_t"], p1["masks_t"]
+            ).T  # [b, W1p]
+            idx, valid, n_cand, cls2_t, lens2 = _gate_and_compact(
+                acc1, cls_t_local.T, lens_local
+            )
+            blk2 = min(block, K)
+            maxtile2 = jnp.asarray(
+                -(-lens2.reshape(K // blk2, blk2).max(axis=1) // cols),
+                dtype=jnp.int32,
+            )
+            acc2 = call2(
+                maxtile2, cls2_t.T, lens2[None, :],
+                p2["btab_t"], p2["masks_t"],
+            ).T  # [K, wps2]
+            m2 = _extract_local(
+                acc2, lens2,
+                p2["acc_word"], p2["acc_mask"], p2["branch_rule"],
+                p2["always_match"], p2["empty_only"],
+                n_filt, wps2,
+            )
+            bits = _merge(idx, valid, m2, _always_bits(acc1), b_local)
+            return bits, n_cand[None]
+
+        in_specs = (
+            {"btab_t": P(), "masks_t": P()}, _pallas_specs(),
+            P(None, "dp"), P("dp"),
+        )
+    else:
+
+        def local_step(p1, p2, cls_local, lens_local):
+            acc1 = nfa_jax.nfa_scan(p1, cls_local, lens_local)  # [b, W1]
+            idx, valid, n_cand, cls2, lens2 = _gate_and_compact(
+                acc1, cls_local, lens_local
+            )
+            acc2 = nfa_jax.nfa_scan(p2, cls2, lens2)            # [K, W2l]
+            m2 = _extract_local(
+                acc2, lens2,
+                p2["acc_word"], p2["acc_mask"], p2["branch_rule"],
+                p2["always_match"], p2["empty_only"],
+                n_filt, wps2,
+            )
+            bits = _merge(idx, valid, m2, _always_bits(acc1), b_local)
+            return bits, n_cand[None]
+
+        p1_specs = {k: P() for k in params1}
+        in_specs = (p1_specs, _param_specs(), P("dp", None), P("dp"))
+
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(P("dp", None), P("dp")),
+        check_vma=False,
+    )
+    return jax.jit(fn), (params1, params2), K
+
+
 class ShardedMatchBackend:
     """Batch-level mesh matcher: the drop-in device backend for TpuMatcher.
 
@@ -257,6 +425,8 @@ class ShardedMatchBackend:
         max_len: int,
         backend: str = "pallas",   # pallas | pallas-interpret | xla
         block_b: int = 128,
+        plan=None,                 # prefilter.PrefilterPlan (stage2 rp-packed)
+        cand_frac: float = 0.125,
     ):
         self.mesh = mesh
         self.dp = mesh.shape["dp"]
@@ -265,7 +435,14 @@ class ShardedMatchBackend:
         self.n_rules = compiled.n_rules
         self.max_len = max_len
         self.block_b = block_b
+        self.cand_frac = cand_frac
         self._fns: Dict[Tuple[int, int], object] = {}
+        self._fused_fns: Dict[Tuple[int, int], object] = {}
+        self.plan = plan
+        # counters for observability: how often the fused path ran vs fell
+        # back to the single-stage sharded NFA (candidate overflow)
+        self.fused_batches = 0
+        self.fallback_batches = 0
         if backend == "xla":
             self._prep = None
             self._params = shard_params(compiled, mesh)
@@ -288,6 +465,17 @@ class ShardedMatchBackend:
                 )
             self._fns[key] = fn
         return fn
+
+    def _fused(self, B: int, L_p: int):
+        key = (B, L_p)
+        hit = self._fused_fns.get(key)
+        if hit is None:
+            hit = sharded_fused_fn(
+                self.plan, self.mesh, B, L_p, self.block_b, self.backend,
+                cand_frac=self.cand_frac,
+            )
+            self._fused_fns[key] = hit
+        return hit
 
     def match_bits(self, cls_ids: np.ndarray, lens: np.ndarray) -> np.ndarray:
         """[B, L] encoded lines → [B, n_rules] uint8, any B (dp remainder
@@ -329,16 +517,51 @@ class ShardedMatchBackend:
         cls_dev = cls_sorted[perm]
         lens_dev = lens_sorted[perm]
 
-        fn = self._fn(Bp, L_p)
-        if self.backend == "xla":
-            out = np.asarray(
-                fn(self._params, jnp.asarray(cls_dev), jnp.asarray(lens_dev))
-            )
-        else:
-            cls_t = np.ascontiguousarray(cls_dev.T)
-            out = np.asarray(
-                fn(self._params, jnp.asarray(cls_t), jnp.asarray(lens_dev))
-            )
+        out = None
+        if self.plan is not None:
+            # fused two-stage: stage-1 gate per dp shard, stage-2 on the
+            # compacted candidates only; per-shard candidate overflow
+            # (adversarial all-matching traffic) falls back to the
+            # single-stage sharded NFA — never under-matches
+            fn, params, K = self._fused(Bp, L_p)
+            if self.backend == "xla":
+                bits_d, n_cand = fn(
+                    *params, jnp.asarray(cls_dev), jnp.asarray(lens_dev)
+                )
+            else:
+                cls_t = np.ascontiguousarray(cls_dev.T)
+                bits_d, n_cand = fn(
+                    *params, jnp.asarray(cls_t), jnp.asarray(lens_dev)
+                )
+            if int(np.asarray(n_cand).max()) <= K:
+                # np.array (not asarray): the jax buffer is read-only and
+                # the always-rule flags write into it below
+                out = np.array(bits_d)
+                self.fused_batches += 1
+                # always-rule static flags (host-applied, like the
+                # single-device collect())
+                plan = self.plan
+                if plan.n_always:
+                    aw = np.asarray(plan.stage1.always_match[: plan.n_always])
+                    ae = np.asarray(plan.stage1.empty_only[: plan.n_always])
+                    if aw.any():
+                        out[:, plan.a_idx[aw]] = 1
+                    if ae.any():
+                        empty_rows = np.flatnonzero(lens_dev == 0)
+                        out[np.ix_(empty_rows, plan.a_idx[ae])] = 1
+            else:
+                self.fallback_batches += 1
+        if out is None:
+            fn = self._fn(Bp, L_p)
+            if self.backend == "xla":
+                out = np.asarray(
+                    fn(self._params, jnp.asarray(cls_dev), jnp.asarray(lens_dev))
+                )
+            else:
+                cls_t = np.ascontiguousarray(cls_dev.T)
+                out = np.asarray(
+                    fn(self._params, jnp.asarray(cls_t), jnp.asarray(lens_dev))
+                )
 
         # undo the device permutation, then the length sort
         unperm = np.empty(Bp, dtype=np.int64)

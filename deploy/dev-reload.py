@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Rebuild-on-save dev loop — the reference's `.air.toml` + entrypoint dance
+(air rebuilds the Go binary when sources change; reference entrypoint.sh:3-7)
+translated to the Python runtime: watch the source tree, restart the server
+on change, SIGHUP it when only the config file changed (hot reload instead
+of a restart, matching the product's own reload path).
+
+Stdlib-only (mtime polling — inotify isn't portable into slim containers):
+
+    python deploy/dev-reload.py -- -config-file deploy/banjax-config.yaml \
+        -standalone-testing
+
+or in the container via BANJAX_DEV=1 (see entrypoint.sh).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+POLL_SECONDS = 0.7
+WATCH_EXTS = {".py", ".html", ".c"}
+
+
+def _snapshot(root: str):
+    out = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [
+            d for d in dirnames
+            if d not in {"__pycache__", ".git", "node_modules", "logs"}
+        ]
+        for f in filenames:
+            if os.path.splitext(f)[1] in WATCH_EXTS:
+                p = os.path.join(dirpath, f)
+                try:
+                    out[p] = os.stat(p).st_mtime_ns
+                except OSError:
+                    pass
+    return out
+
+
+def main() -> None:
+    args = sys.argv[1:]
+    if args and args[0] == "--":
+        args = args[1:]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "banjax_tpu")
+    config_file = None
+    for i, a in enumerate(args):
+        if a == "-config-file" and i + 1 < len(args):
+            config_file = os.path.abspath(args[i + 1])
+
+    cmd = [sys.executable, "-m", "banjax_tpu.cli", *args]
+    proc = None
+    try:
+        while True:
+            snap = _snapshot(src)
+            cfg_m = os.stat(config_file).st_mtime_ns if config_file else 0
+            print(f"[dev-reload] starting: {' '.join(cmd)}", flush=True)
+            proc = subprocess.Popen(cmd, cwd=repo)
+            while True:
+                time.sleep(POLL_SECONDS)
+                if proc.poll() is not None:
+                    print(
+                        f"[dev-reload] server exited rc={proc.returncode}; "
+                        "restarting after next change", flush=True,
+                    )
+                    # wait for a change before relaunching a crash-looper
+                    while _snapshot(src) == snap:
+                        time.sleep(POLL_SECONDS)
+                    break
+                if config_file:
+                    try:
+                        m = os.stat(config_file).st_mtime_ns
+                    except OSError:
+                        m = cfg_m
+                    if m != cfg_m:
+                        cfg_m = m
+                        print("[dev-reload] config changed → SIGHUP "
+                              "(hot reload)", flush=True)
+                        proc.send_signal(signal.SIGHUP)
+                        continue
+                if _snapshot(src) != snap:
+                    print("[dev-reload] source changed → restart", flush=True)
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                    break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,9 @@
 # BANJAX_DEBUG=1      verbose per-line/per-request logging
 # BANJAX_STANDALONE=1 standalone-testing mode (no nginx: fake the X-* headers,
 #                     self-write the access log, skip ipset)
+# BANJAX_DEV=1        rebuild-on-save dev loop (deploy/dev-reload.py): restart
+#                     on source change, SIGHUP on config change — the
+#                     reference's air-based live rebuild (.air.toml)
 set -e
 
 CONFIG="${BANJAX_CONFIG:-/etc/banjax/banjax-config.yaml}"
@@ -12,4 +15,7 @@ ARGS="-config-file $CONFIG"
 [ -n "$BANJAX_DEBUG" ] && ARGS="$ARGS -debug"
 [ -n "$BANJAX_STANDALONE" ] && ARGS="$ARGS -standalone-testing"
 
+if [ -n "$BANJAX_DEV" ]; then
+    exec python /opt/banjax-tpu/deploy/dev-reload.py -- $ARGS
+fi
 exec python -m banjax_tpu.cli $ARGS
