@@ -84,6 +84,9 @@ class PallasRules:
     branch_rule: jnp.ndarray  # [n_branches] int32
     always_match: jnp.ndarray  # [n_rules] bool
     empty_only: jnp.ndarray    # [n_rules] bool
+    # carry_free (see prepare()): word-aligned branches let the kernel drop
+    # the cross-word carry — 3 of ~13 VPU ops per byte column
+    carry_free: bool = False
     # jitted device_matcher per (B, L_p, block_b, interpret) — a mutable
     # cache inside a frozen dataclass, keyed per ruleset by construction
     _fns: dict = dataclasses.field(default_factory=dict, compare=False, repr=False)
@@ -140,6 +143,13 @@ def prepare(compiled: CompiledRules) -> PallasRules:
     a grid step over shard j addresses a self-contained, aligned word slab;
     accept-word indices are remapped to match. Padding words carry all-zero
     masks, so any state bit shifted into them is annihilated by `& bmask`.
+
+    (Accept-absorption was tried and REVERTED: making accept-any bits'
+    b_table rows class-independent persists accepted bits, but it also
+    lets the shift-in ENTER the accept position without checking the byte
+    — a prefix of a literal followed by any pad byte falsely accepts.
+    Separating "enter" from "persist" costs the same 2 VPU ops the trick
+    would save, so the per-column accumulation stays.)
     """
     ns, wps = compiled.n_shards, compiled.words_per_shard
     wps_p = max(_LANE, _pad_to(wps, _LANE))
@@ -189,11 +199,12 @@ def prepare(compiled: CompiledRules) -> PallasRules:
         branch_rule=jnp.asarray(compiled.branch_rule),
         always_match=jnp.asarray(compiled.always_match),
         empty_only=jnp.asarray(compiled.empty_only),
+        carry_free=compiled.carry_free,
     )
 
 
 def _kernel(maxtile_ref, cls_rows_ref, lens_ref, btab_ref, masks_ref,
-            out_ref, d_ref, *, C, W, use_roll, cols):
+            out_ref, d_ref, *, C, W, use_roll, cols, carry=True):
     """One (line-block, rule-shard, byte-tile) grid step: `cols` byte columns."""
     i = pl.program_id(0)
     t = pl.program_id(2)
@@ -243,16 +254,22 @@ def _kernel(maxtile_ref, cls_rows_ref, lens_ref, btab_ref, masks_ref,
                 p = p << (8 * plane) if plane else p
                 s = p if s is None else s + p
             bmask = (s + jnp.int32(-0x7F7F7F80)).astype(jnp.uint32)
-            c31 = d >> 31
-            if use_roll:
-                sub0 = jax.lax.broadcasted_iota(jnp.int32, (W, bB), 0) == 0
-                carry_bits = pltpu.roll(c31, shift=1, axis=0)
-                carry_bits = jnp.where(sub0, zero, carry_bits)
-            else:  # interpret mode: plain-JAX equivalent of the sublane roll
-                carry_bits = jnp.concatenate(
-                    [jnp.zeros((1, bB), jnp.uint32), c31[:-1, :]], axis=0
-                )
-            shifted = ((d << 1) | carry_bits) & shift_in
+            if carry:
+                c31 = d >> 31
+                if use_roll:
+                    sub0 = jax.lax.broadcasted_iota(jnp.int32, (W, bB), 0) == 0
+                    carry_bits = pltpu.roll(c31, shift=1, axis=0)
+                    carry_bits = jnp.where(sub0, zero, carry_bits)
+                else:  # interpret mode: plain-JAX equivalent of the sublane roll
+                    carry_bits = jnp.concatenate(
+                        [jnp.zeros((1, bB), jnp.uint32), c31[:-1, :]], axis=0
+                    )
+                shifted = ((d << 1) | carry_bits) & shift_in
+            else:
+                # carry-free packing: no branch straddles a word, so the
+                # shifted-out bit 31 could only land on a branch-start or
+                # padding bit, both outside shift_in — drop the whole carry
+                shifted = (d << 1) & shift_in
             if k == 0:
                 inject = jnp.where(t == 0, inj_always | inj_start, inj_always)
             else:
@@ -277,7 +294,7 @@ def device_matcher(prep: PallasRules, B: int, L_p: int,
     per step on v5e) at the cost of L_p padding up to a `cols` multiple."""
     call = _build_raw_call(
         B, L_p, prep.n_classes_p, prep.n_shards, prep.wps_p, block_b,
-        interpret, cols
+        interpret, cols, carry=not prep.carry_free,
     )
     acc_word, acc_mask = prep.acc_word, prep.acc_mask
     branch_rule = prep.branch_rule
@@ -314,7 +331,12 @@ def _build_raw_call(
     B: int, L_p: int, C: int, ns: int, wps_p: int, block_b: int,
     interpret: bool, cols: int = _COLS_PER_STEP,
     force_roll: "bool | None" = None,
+    carry: bool = True,
 ):
+    """`carry=False` is only sound against tensors packed word-aligned
+    (prepare() reported carry_free) — pass prep's own flag. The safe
+    default (carry on) is merely redundant work against aligned tensors,
+    never wrong."""
     if B % block_b or L_p % cols:
         # a floor-divided grid would silently skip the tail of the batch
         raise PallasUnsupported(
@@ -329,7 +351,7 @@ def _build_raw_call(
     # the concatenate fallback stays for interpreters where roll regresses
     use_roll = (not interpret) if force_roll is None else force_roll
     kern = functools.partial(
-        _kernel, C=C, W=wps_p, use_roll=use_roll, cols=cols
+        _kernel, C=C, W=wps_p, use_roll=use_roll, cols=cols, carry=carry,
     )
     call = pl.pallas_call(
         kern,

@@ -150,7 +150,13 @@ def build_plan(
 
     stage1_programs = [programs[i] for i in always_ids] + factor_progs
     stage2_programs = [programs[i] for i in filt_ids]
-    s1 = pack_programs(stage1_programs, n_shards="auto", byte_classes=byte_classes)
+    # stage 1 is the scan-bound hot automaton: word-align its branches so
+    # the kernel drops the cross-word carry (factors are 3-12 positions, so
+    # alignment costs little padding and carry_free always holds for them)
+    s1 = pack_programs(
+        stage1_programs, n_shards="auto", byte_classes=byte_classes,
+        align_branches=True,
+    )
     # stage2_shards=rp pins the word slabs to a mesh's rule-parallel axis
     s2 = pack_programs(
         stage2_programs, n_shards=stage2_shards, byte_classes=byte_classes
@@ -366,7 +372,8 @@ class FusedPrefilter:
             prep = self._preps["s1"]
             call = nfa_match._build_raw_call(
                 B, L_p, prep.n_classes_p, prep.n_shards, prep.wps_p, block,
-                self.interpret, self._cols
+                self.interpret, self._cols,
+                carry=not prep.carry_free,
             )
             btab, masks = prep.btab_t, prep.masks_t
             cols = self._cols

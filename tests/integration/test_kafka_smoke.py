@@ -65,9 +65,7 @@ def test_produce_consume_roundtrip():
     tx = WireKafkaTransport()
     try:
         tx.send(cfg, topic, b'{"warm": true}')  # creates the topic
-        time.sleep(1.0)
         it = tx.read_messages(cfg, topic, 0)  # LastOffset: starts at tail
-        payload = json.dumps({"n": 1, "t": time.time()}).encode()
 
         got = {}
 
@@ -76,10 +74,20 @@ def test_produce_consume_roundtrip():
 
         t = threading.Thread(target=consume, daemon=True)
         t.start()
-        time.sleep(1.0)  # consumer positioned at the tail before we produce
-        tx.send(cfg, topic, payload)
-        t.join(timeout=15)
-        assert got.get("msg") == payload
+        # a tail-positioned consumer only sees messages produced AFTER it
+        # attaches; its attach time is unobservable, so keep producing
+        # fresh sequence-tagged messages until one comes through (no fixed
+        # sleeps — robust against a cold broker)
+        sent = set()
+        deadline = time.time() + 30
+        seq = 0
+        while time.time() < deadline and "msg" not in got:
+            payload = json.dumps({"seq": seq}).encode()
+            sent.add(payload)
+            tx.send(cfg, topic, payload)
+            seq += 1
+            t.join(timeout=0.5)
+        assert got.get("msg") in sent
     finally:
         tx.close()
 
@@ -92,24 +100,21 @@ def test_challenge_ip_command_end_to_end():
     reader = KafkaReader(_Holder(cfg), lists, transport=WireKafkaTransport())
     try:
         producer.send(cfg, topic, b'{"warm": true}')
-        time.sleep(1.0)
         reader.start()
-        time.sleep(2.0)  # reader at the tail
-        producer.send(
-            cfg,
-            topic,
-            json.dumps(
-                {"Name": "challenge_ip", "Value": "203.0.113.9",
-                 "host": "example.com"}
-            ).encode(),
-        )
-        deadline = time.time() + 15
+        # the reader attaches at the tail at an unobservable moment: resend
+        # the (idempotent) command until it lands instead of fixed sleeps
+        cmd = json.dumps(
+            {"Name": "challenge_ip", "Value": "203.0.113.9",
+             "host": "example.com"}
+        ).encode()
+        deadline = time.time() + 30
         entry = None
         while time.time() < deadline:
+            producer.send(cfg, topic, cmd)
+            time.sleep(1.0)
             entry, ok = lists.check("", "203.0.113.9")
             if ok and entry is not None:
                 break
-            time.sleep(0.25)
         assert entry is not None, "challenge_ip never landed"
         assert entry.decision is Decision.CHALLENGE
     finally:

@@ -101,6 +101,9 @@ class TestTwoStageEquivalence:
         plan = build_plan(patterns)
         assert plan is not None
         assert plan.stage1.n_words < plan.stage2.n_words
+        # stage 1 packs word-aligned so the kernel drops the cross-word
+        # carry; factors are <= 12 positions so this must always hold
+        assert plan.stage1.carry_free
         assert plan.n_always + len(plan.f_idx) == len(
             [p for i, p in enumerate(patterns) if i not in plan.unsupported]
         )

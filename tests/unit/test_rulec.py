@@ -252,3 +252,45 @@ class TestFuzzDifferential:
         ]
         assert_matches_re(patterns, lines, n_shards=1)
         assert_matches_re(patterns, lines, n_shards=4)
+
+
+def test_align_branches_word_alignment_and_equivalence():
+    """align_branches=True: no <=32-position branch straddles a word, the
+    packed tensors still match the dense packing bit-for-bit through the
+    matcher, and carry_free is reported correctly."""
+    import numpy as np
+
+    from banjax_tpu.matcher import nfa_jax
+    from banjax_tpu.matcher.encode import encode_for_match
+    from banjax_tpu.matcher.rulec import compile_rule, pack_programs
+
+    pats = [r"GET /wp-login\.php", r"/xmlrpc\.php", r"(?i)sqlmap|nikto",
+            r"POST /login[0-9]{1,3}", r"^HEAD /x\.cgi$"]
+    programs = [compile_rule(p) for p in pats]
+    dense = pack_programs(programs)
+    aligned = pack_programs(programs, align_branches=True)
+    assert aligned.carry_free
+    assert aligned.n_words >= dense.n_words  # alignment may pad
+    lines = ["GET x GET /wp-login.php -", "POST a POST /login77 -",
+             "NIKTO scan", "HEAD /x.cgi", "benign"]
+    for packed in (dense, aligned):
+        cls_ids, lens, _ = encode_for_match(packed, lines, 64)
+        got = np.asarray(nfa_jax.match_batch(
+            nfa_jax.match_params(packed), cls_ids, lens, packed.n_rules
+        ))
+        import re as _re
+
+        for j, p in enumerate(pats):
+            for i, line in enumerate(lines):
+                assert bool(got[i, j]) == bool(_re.search(p, line)), (p, line)
+
+
+def test_align_branches_long_branch_not_carry_free():
+    """A >32-position branch must straddle words: carry_free stays False
+    so the kernel keeps its cross-word carry."""
+    from banjax_tpu.matcher.rulec import compile_rule, pack_programs
+
+    packed = pack_programs(
+        [compile_rule("a" * 40)], align_branches=True
+    )
+    assert not packed.carry_free
