@@ -65,4 +65,11 @@ def assert_mesh_matches_cpu_oracle(
     assert [(b.ip, b.decision, b.domain) for b in tpu_b.bans] == [
         (b.ip, b.decision, b.domain) for b in cpu_b.bans
     ], "Banner side effects diverged"
+    mm = tpu_m._mesh_matcher
+    if mm.plan is not None:
+        # a filterable ruleset must actually go through the fused two-stage
+        # path (or its counted overflow fallback) — not silently skip it
+        assert mm.fused_batches + mm.fallback_batches > 0, (
+            "fused mesh prefilter never ran"
+        )
     return tpu_m

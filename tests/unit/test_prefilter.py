@@ -272,6 +272,27 @@ class TestFusedPrefilter:
         bits = fp.match_bits_encoded(cls_ids, lens)
         np.testing.assert_array_equal(bits, want)
 
+    def test_unpacked_input_path_parity(self):
+        """The plain-int32 input layout (used when a byte partition doesn't
+        fit uint8) must match the packed default bit-for-bit."""
+        from banjax_tpu.matcher.prefilter import FusedPrefilter
+
+        import bench
+
+        patterns = bench.generate_rules(30, seed=12)
+        lines = bench.generate_lines(200, patterns, seed=13, attack_rate=0.2)
+        compiled, plan = self._plan(patterns)
+        assert plan is not None
+        cls_ids, lens, _, want = self._oracle(compiled, plan, lines)
+        fp = FusedPrefilter(plan, "xla", cand_frac=1.0, out_frac=1.0)
+        assert fp._pack_input  # packed is the default on LE hosts
+        packed = fp.match_bits_encoded(cls_ids, lens)
+        fp2 = FusedPrefilter(plan, "xla", cand_frac=1.0, out_frac=1.0)
+        fp2._pack_input = False
+        unpacked = fp2.match_bits_encoded(cls_ids, lens)
+        np.testing.assert_array_equal(packed, want)
+        np.testing.assert_array_equal(unpacked, want)
+
     def test_overflow_raises(self):
         from banjax_tpu.matcher.prefilter import (
             FusedPrefilter,
