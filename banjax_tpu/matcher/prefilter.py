@@ -465,9 +465,7 @@ class FusedPrefilter:
             if packed_in:
                 words = cls_and_lens[:, 1 : 1 + L4]              # [B, L4]
                 cls_rows = (
-                    (words[:, :, None]
-                     >> (jnp.arange(4, dtype=jnp.int32) * 8)[None, None, :])
-                    & 0xFF
+                    (words[:, :, None] >> shifts[None, None, :]) & 0xFF
                 ).reshape(words.shape[0], L4 * 4)[:, :L_p]
             else:
                 cls_rows = cls_and_lens[:, 1 : 1 + L_p]          # [B, L_p]
