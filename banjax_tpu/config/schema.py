@@ -159,8 +159,13 @@ class Config:
     # size this above the expected concurrent distinct-IP count.
     matcher_window_capacity: int = 16384  # IP slots (LRU-evicted)
     # two-stage literal prefilter (matcher/prefilter.py): bit-identical
-    # output, auto-disabled for rulesets with too few filterable rules
+    # output, auto-disabled for rulesets with too few filterable rules.
+    # cand_frac sizes the candidate capacity as a fraction of the batch:
+    # a batch whose stage-1 hit rate exceeds it falls back to the
+    # single-stage matcher (correct but slower) — raise it for rulesets
+    # whose factors fire often on benign traffic
     matcher_prefilter: bool = True
+    matcher_prefilter_cand_frac: float = 0.125
     # multi-device mesh (parallel/mesh.py): shard the line batch over `dp`
     # devices and the packed NFA word axis over `rp` devices (dp * rp =
     # matcher_mesh_devices). 0 = single-device. matcher_mesh_rp 0 = auto
@@ -200,6 +205,7 @@ _SCALAR_KEYS = {
     "matcher": str, "matcher_batch_lines": int, "matcher_max_line_len": int,
     "matcher_backend": str, "matcher_device_windows": bool,
     "matcher_window_capacity": int, "matcher_prefilter": bool,
+    "matcher_prefilter_cand_frac": float,
     "matcher_mesh_devices": int, "matcher_mesh_rp": int,
     "matcher_native_parse": bool,
 }
@@ -244,6 +250,11 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
             elif typ is bool:
                 if not isinstance(value, bool):
                     raise ValueError(f"config key {key}: expected bool, got {value!r}")
+            elif typ is float:
+                # YAML parses `1` as int: accept and coerce (bools excluded)
+                if isinstance(value, bool) or not isinstance(value, (int, float)):
+                    raise ValueError(f"config key {key}: expected float, got {value!r}")
+                value = float(value)
             elif not isinstance(value, typ):
                 raise ValueError(f"config key {key}: expected {typ.__name__}, got {value!r}")
             setattr(cfg, key, value)

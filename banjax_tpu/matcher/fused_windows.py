@@ -1,0 +1,388 @@
+"""Fused matcher + device-windows pipeline: one device dispatch per batch.
+
+Without this, the device-windows path round-trips the match bitmap through
+the host: the fused matcher pulls its sparse result down (~65 ms fixed
+tunnel latency), the runner reconstructs a dense [B, n_rules] bitmap, and
+apply_bitmap pushes those ~16 MB straight back up for the window scan —
+two transfers and an extra dispatch of pure overhead on the hot path
+(BASELINE.json configs[4]/[5], the live-stream shape).
+
+Here the dense caller-order bitmap never exists on the host: the two-stage
+match (prefilter._match_core) and the window apply (windows._apply_core)
+trace into ONE jit. Per batch the host sends the combined class array plus
+four small per-line vectors (slots, ts_s, ts_ns, host row), and receives
+ONE buffer: overflow flags ‖ window events ‖ the sparse matched rows for
+ConsumeLineResult bookkeeping. The window state is donated through the
+dispatch; all three overflow conditions (candidates > K, matched rows > E,
+events > max_events) gate every state write OFF on device (windows
+_apply_core `gate`), so an overflowing batch leaves the counters
+bit-identical and the caller reruns it through the classic splitting path
+using the dense bitmap — which this program also returns as a
+device-resident output (free unless that fallback actually pulls it).
+
+Event order parity: bits are scattered into CALLER row order before the
+window apply, so the event compaction's row-major (line, rule) order — the
+reference's per-site-then-global processing order — is preserved exactly
+as in the classic path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from banjax_tpu.matcher import windows as W
+from banjax_tpu.matcher.prefilter import FusedPrefilter
+from banjax_tpu.matcher.windows import DeviceWindows, WindowEvent
+from banjax_tpu.decisions.rate_limit import RateLimitMatchType
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _PendingBatch:
+    buf: object            # uint8 result buffer (copy_to_host_async started)
+    bits_dev: object       # [B, n_rules] uint8 device-resident (fallback use)
+    slots: np.ndarray      # caller-order slot per line (pins held)
+    ts_s: np.ndarray
+    ts_ns: np.ndarray
+    host_idx: np.ndarray
+    B: int                 # real rows
+    K: int
+    E: int
+    seq: int = 0           # submit order (collects must match it)
+
+
+class FusedWindowsPipeline:
+    """Builds and runs the single-dispatch match+windows program.
+
+    Constructed by TpuMatcher when both the fused prefilter and device
+    windows are active. submit() must be called with the windows slot pins
+    already held (slots_for_ips); collect() consumes the events, updates
+    the host shadow, and releases the pins — or runs the classic fallback
+    on overflow (which releases them itself)."""
+
+    def __init__(self, prefilter: FusedPrefilter, windows: DeviceWindows,
+                 active_table, n_rules: int):
+        self.pf = prefilter
+        self.windows = windows
+        self.active_table = jnp.asarray(active_table)
+        self.n_rules = n_rules
+        self._fns = {}
+        plan = prefilter.plan
+        self._f_idx = jnp.asarray(plan.f_idx, dtype=jnp.int32)
+        self._a_idx = jnp.asarray(plan.a_idx, dtype=jnp.int32)
+        na = plan.n_always
+        self._aw = jnp.asarray(
+            np.asarray(plan.stage1.always_match[:na], dtype=np.uint8)
+        )
+        self._ae = jnp.asarray(
+            np.asarray(plan.stage1.empty_only[:na], dtype=np.uint8)
+        )
+        # overflows observable in metrics
+        self.fused_batches = 0
+        self.fallback_batches = 0
+        # collect-order gate: the host shadow must absorb batches in the
+        # order their device applies ran (= submit order). Concurrent
+        # callers' collects serialize on this sequence — the same
+        # invariant windows._apply_bitmap_inner keeps by doing the state
+        # swap and the shadow write in one lock window.
+        import threading
+
+        self._seq_cv = threading.Condition()
+        self._next_seq = 0
+        self._collect_seq = 0
+
+    # ---- device program ----
+
+    def _step(self, B: int, L_p: int):
+        key = (B, L_p)
+        hit = self._fns.get(key)
+        if hit is not None:
+            return hit
+        pf, wnd = self.pf, self.windows
+        plan = pf.plan
+        block, K, E = pf.capacities(B)
+        core = pf._match_core(B, L_p, K, E, block)
+        n_rules, n_filt = self.n_rules, plan.stage2.n_rules
+        n_always = plan.n_always
+        f_idx, a_idx = self._f_idx, self._a_idx
+        aw, ae = self._aw, self._ae
+        max_events = wnd.max_events
+        limits, iv_s, iv_ns = wnd._limits, wnd._iv_s, wnd._iv_ns
+        active_table = self.active_table
+        shifts = jnp.asarray([0, 8, 16, 24], dtype=jnp.int32)
+
+        def unpack_rule_bits(packed):  # [K, nf8] -> [K, n_filt] uint8 0/1
+            b = (packed[:, :, None] >> (7 - jnp.arange(8, dtype=jnp.uint8))) & 1
+            return b.reshape(packed.shape[0], -1)[:, :n_filt]
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, combined, n_real, slots, ts_s, ts_ns, host_idx):
+            c = core(combined)
+            # dense caller-order bitmap, assembled on device
+            m2 = unpack_rule_bits(c["m2p"])                      # [K, n_filt]
+            filt = jnp.zeros((B + 1, n_filt), dtype=jnp.uint8)
+            filt = filt.at[c["idx_caller_k"]].set(m2)[:B]        # row B = dump
+            bits = jnp.zeros((B, n_rules), dtype=jnp.uint8)
+            bits = bits.at[:, f_idx].set(filt)
+            if n_always:
+                ab = c["ab_caller"] | aw[None, :]
+                empty = (c["lens_raw"] == 0).astype(jnp.uint8)[:, None]
+                ab = ab | (ae[None, :] * empty)
+                bits = bits.at[:, a_idx].set(ab)
+
+            # padding rows (row >= n_real) can still carry bits — e.g. an
+            # always_match rule's column is all-ones — and MUST NOT reach
+            # the window apply: their pad slot id 0 belongs to a real IP.
+            # Mask the bitmap itself; _apply_core derives its fires from it.
+            real = jax.lax.iota(jnp.int32, B) < n_real
+            bits = bits * real[:, None].astype(jnp.uint8)
+            fire = (bits != 0) & active_table[host_idx]
+            n_events = fire.sum(dtype=jnp.int32)
+            ok = (
+                (c["n_cand"] <= K) & (c["n_m"] <= E)
+                & (n_events <= max_events)
+            )
+            new_state, ev = W._apply_core(
+                state, bits, active_table, host_idx, slots, ts_s, ts_ns,
+                limits, iv_s, iv_ns,
+                n_rules=n_rules, max_events=max_events, gate=ok,
+            )
+            flags = jnp.stack([
+                ok.astype(jnp.int32), c["n_cand"], c["n_m"], n_events,
+            ])
+            parts = [
+                ((flags[:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                # window events (reference order after host sort by
+                # (line, rule)): int32 lanes for line/rule/hits/ss/sns,
+                # uint8 for mtype/exceeded/seen
+                ((ev["line"][:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                ((ev["rule"][:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                ((ev["hits"][:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                ((ev["start_s"][:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                ((ev["start_ns"][:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                ev["match_type"].astype(jnp.uint8),
+                ev["exceeded"].astype(jnp.uint8),
+                ev["seen_ip"].astype(jnp.uint8),
+                # sparse matched rows for ConsumeLineResult bookkeeping
+                ((c["idx_caller"][:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                c["rows"].reshape(-1),
+            ]
+            if n_always:
+                # always-rule bits per line: the sparse rows cover only the
+                # filterable rules, but replay bookkeeping needs e.g. a
+                # catch-all `.*` rule's per-line matches too. Pack the
+                # COMPLETED ab (static always_match/empty_only flags
+                # included), not the raw branch accepts.
+                parts.append(
+                    jnp.packbits(ab.astype(jnp.bool_), axis=1).reshape(-1)
+                )
+            return new_state, jnp.concatenate(parts), bits
+
+        self._fns[key] = (step, K, E)
+        return step, K, E
+
+    # ---- host API ----
+
+    def submit(
+        self, cls_ids: np.ndarray, lens: np.ndarray, slots: np.ndarray,
+        ts_s: np.ndarray, ts_ns: np.ndarray, host_idx: np.ndarray,
+    ) -> _PendingBatch:
+        """Dispatch one batch (slot pins held by the caller). The window
+        state swap happens here under the windows lock — device-stream
+        order then guarantees a later batch's maintenance (evictions /
+        restores) executes after this batch's apply."""
+        pf, wnd = self.pf, self.windows
+        cls_ids = np.asarray(cls_ids, dtype=np.int32)
+        lens = np.asarray(lens, dtype=np.int32)
+        B = cls_ids.shape[0]
+        combined, Bp, L_p = pf._assemble(cls_ids, lens)
+        step, K, E = self._step(Bp, L_p)
+
+        def pad(a, fill=0):
+            a = np.asarray(a)
+            if Bp == B:
+                return a
+            return np.concatenate(
+                [a, np.full(Bp - B, fill, dtype=a.dtype)]
+            )
+
+        with wnd._lock:
+            wnd._run_maintenance_locked()
+            new_state, buf, bits_dev = step(
+                wnd._state, jnp.asarray(combined), jnp.int32(B),
+                jnp.asarray(pad(slots)), jnp.asarray(pad(ts_s)),
+                jnp.asarray(pad(ts_ns)), jnp.asarray(pad(host_idx)),
+            )
+            wnd._state = new_state
+        try:
+            buf.copy_to_host_async()
+        except AttributeError:
+            pass
+        with self._seq_cv:
+            seq = self._next_seq
+            self._next_seq += 1
+        return _PendingBatch(
+            buf=buf, bits_dev=bits_dev, slots=np.asarray(slots),
+            ts_s=np.asarray(ts_s), ts_ns=np.asarray(ts_ns),
+            host_idx=np.asarray(host_idx), B=B, K=K, E=E, seq=seq,
+        )
+
+    def collect(self, p: _PendingBatch) -> "FusedWindowsResult":
+        """Block on a submit()ed batch (collects serialize in submit order
+        so shadow writes land in device-apply order). Overflow taxonomy:
+
+        * fused ok — events + sparse matched rows decode from the buffer,
+          the host shadow updates, pins release here.
+        * candidates fit K but rows/events overflowed — the dense device
+          bitmap IS complete; the batch replays through the classic
+          apply_bitmap (splits as needed, releases the pins itself). The
+          sparse rows are valid only when n_m <= E; otherwise the caller
+          reads result.bits (one dense pull, rare path).
+        * candidates overflowed K — stage 2 never saw the excess lines, so
+          even the dense bitmap is incomplete: events is None, bits is
+          None, and the PINS STAY HELD — the caller must recompute the
+          bitmap single-stage and run apply_bitmap with the same slots
+          (which releases them).
+        """
+        # serialize collects in submit order: a later batch's shadow write
+        # landing before an earlier one would leave stale counters that an
+        # eviction could later restore as authoritative
+        with self._seq_cv:
+            while self._collect_seq != p.seq:
+                self._seq_cv.wait()
+        try:
+            return self._collect_inner(p)
+        finally:
+            with self._seq_cv:
+                self._collect_seq += 1
+                self._seq_cv.notify_all()
+
+    def _collect_inner(self, p: _PendingBatch) -> "FusedWindowsResult":
+        wnd = self.windows
+        max_events = wnd.max_events
+        E = p.E
+        buf = np.asarray(p.buf)
+        off = 0
+
+        def take_i32(n):
+            nonlocal off
+            out = np.frombuffer(buf[off : off + 4 * n].tobytes(), dtype="<i4")
+            off += 4 * n
+            return out
+
+        def take_u8(n):
+            nonlocal off
+            out = buf[off : off + n]
+            off += n
+            return out
+
+        flags = take_i32(4)
+        ok = bool(flags[0])
+        n_cand, n_m = int(flags[1]), int(flags[2])
+        ev_line = take_i32(max_events)
+        ev_rule = take_i32(max_events)
+        ev_hits = take_i32(max_events)
+        ev_ss = take_i32(max_events)
+        ev_sns = take_i32(max_events)
+        ev_mtype = take_u8(max_events)
+        ev_exc = take_u8(max_events)
+        ev_seen = take_u8(max_events)
+        midx = take_i32(E)
+        nf8 = self.pf._nf8
+        rows = take_u8(E * nf8).reshape(E, nf8)
+        na8 = self.pf._na8
+        always_bits = (
+            buf[off:].reshape(-1, na8)[: p.B] if na8 else None
+        )
+
+        def sparse():
+            if n_m > E:
+                return None, None
+            live = midx[:n_m]
+            keep = (live >= 0) & (live < p.B)
+            return live[keep], rows[:n_m][keep]
+
+        if ok:
+            self.fused_batches += 1
+            try:
+                live = np.flatnonzero(ev_rule >= 0)
+                events = [
+                    WindowEvent(
+                        line=int(ev_line[k]),
+                        rule_id=int(ev_rule[k]),
+                        match_type=RateLimitMatchType(int(ev_mtype[k])),
+                        exceeded=bool(ev_exc[k]),
+                        seen_ip=bool(ev_seen[k]),
+                    )
+                    for k in live
+                ]
+                # shadow update mirrors _apply_bitmap_inner: key-sorted
+                # event order, last write per (ip, rule) wins
+                from collections import OrderedDict
+
+                with wnd._lock:
+                    for k in live:
+                        ip = wnd._slot_ip.get(int(p.slots[int(ev_line[k])]))
+                        if ip is None:
+                            continue
+                        od = wnd._shadow.setdefault(ip, OrderedDict())
+                        od[int(ev_rule[k])] = (
+                            int(ev_hits[k]), int(ev_ss[k]), int(ev_sns[k])
+                        )
+                events.sort(key=lambda e: (e.line, e.rule_id))
+                m_rows, m_bits = sparse()
+                return FusedWindowsResult(
+                    events=events, matched_rows=m_rows,
+                    matched_bits=m_bits, always_bits=always_bits,
+                    bits_dev=p.bits_dev, pins_held=False,
+                )
+            finally:
+                wnd.release_pins(p.slots)
+
+        self.fallback_batches += 1
+        if n_cand > p.K:
+            # incomplete bitmap: caller recomputes single-stage and runs
+            # apply_bitmap with p.slots (pins stay held until then)
+            return FusedWindowsResult(
+                events=None, matched_rows=None, matched_bits=None,
+                always_bits=None, bits_dev=None, pins_held=True,
+            )
+        # bitmap complete: classic replay (splits, updates shadow,
+        # releases pins); slice off the padding rows so the row count
+        # matches the unpadded slots/ts vectors
+        events = wnd.apply_bitmap(
+            p.bits_dev[: p.B], p.slots, p.ts_s, p.ts_ns, self.active_table,
+            p.host_idx,
+        )
+        m_rows, m_bits = sparse()
+        return FusedWindowsResult(
+            events=events, matched_rows=m_rows, matched_bits=m_bits,
+            always_bits=always_bits, bits_dev=p.bits_dev, pins_held=False,
+        )
+
+
+@dataclasses.dataclass
+class FusedWindowsResult:
+    """collect()'s outcome; see its docstring for the overflow taxonomy."""
+
+    events: Optional[List[WindowEvent]]   # None: caller must re-apply
+    matched_rows: Optional[np.ndarray]    # caller rows with >=1 stage2 bit
+    matched_bits: Optional[np.ndarray]    # [len(matched_rows), nf8] packed
+    always_bits: Optional[np.ndarray]     # [B, na8] packed always-rule bits
+    bits_dev: object                      # dense device bitmap (may be None)
+    pins_held: bool                       # True: caller owns the slot pins

@@ -423,44 +423,64 @@ class FusedPrefilter:
             return self._block if B >= self._block else 128
         return min(self._block, max(1, B))
 
-    def _fused(self, B: int, L_p: int):
-        key = (B, L_p)
-        hit = self._fns.get(key)
-        if hit is not None:
-            return hit
-        plan = self.plan
+    def _assemble(self, cls_ids: np.ndarray, lens: np.ndarray):
+        """→ (combined [Bp, 1 + L4|L_p] int32, Bp, L_p): the one-transfer
+        input layout of _match_core (col 0 = lens; class ids packed 4 per
+        int32 when the partition fits uint8)."""
+        B = cls_ids.shape[0]
+        block = self._block_for(max(_MIN_BUCKET, B))
+        Bp = max(block, -(-max(1, B) // block) * block)
+        cols = self._cols
+        max_len = int(lens.max()) if B else 0
+        L_p = max(cols, min(
+            -(-cls_ids.shape[1] // cols) * cols,
+            -(-max(1, max_len) // max(32, cols)) * max(32, cols),
+        ))
+        Lc = min(cls_ids.shape[1], L_p)
+        if self._pack_input:
+            L4 = -(-L_p // 4)
+            combined = np.zeros((Bp, 1 + L4), dtype=np.int32)
+            if B:
+                combined[:B, 0] = lens
+                # write class ids straight into combined's byte view (LE
+                # lanes; bytes 0-3 of each row are the lens int32) — no
+                # intermediate buffer, one 4x-smaller copy total
+                v = combined.view(np.uint8).reshape(Bp, (1 + L4) * 4)
+                v[:B, 4 : 4 + Lc] = cls_ids[:, :Lc]
+        else:
+            combined = np.zeros((Bp, 1 + L_p), dtype=np.int32)
+            if B:
+                combined[:B, 0] = lens
+                combined[:B, 1 : 1 + Lc] = cls_ids[:, :Lc]
+        return combined, Bp, L_p
+
+    def capacities(self, B: int):
+        """(block, K candidate slots, E matched-row slots) for a batch."""
         block = self._block_for(B)
         K = min(B, max(block, -(-int(B * self.cand_frac) // block) * block))
-        # matched-row output capacity (matched ⊆ candidates)
         E = min(K, max(64, int(K * self.out_frac)))
+        return block, K, E
+
+    def _match_core(self, B: int, L_p: int, K: int, E: int, block: int):
+        """The traceable two-stage match body, shared by the sparse-output
+        fused program and the fused matcher+windows pipeline
+        (matcher/fused_windows.py). Input: [B, 1 + L4|L_p] int32 combined
+        array (column 0 = lens; class row packed 4 uint8 ids per int32 when
+        the partition fits a byte — see submit()). Returns every
+        intermediate a consumer needs: the candidate compaction, stage-2
+        packed rows, the second (matched-row) compaction, and the
+        always-rule bits in caller row order."""
+        plan = self.plan
         f1 = self._stage1_raw(B, L_p, block)
         f2 = self._stage2(K, L_p, min(block, K))
         n_always = plan.n_always
         fmask = self._fmask
         a_word, a_mask, a_rule = self._a_word, self._a_mask, self._a_rule
-        na8 = self._na8
         shifts = jnp.asarray([0, 8, 16, 24], dtype=jnp.int32)
-
         packed_in = self._pack_input
         L4 = -(-L_p // 4)
 
-        @jax.jit
-        def fused(cls_and_lens):
-            """One int32 input transfer (the tunnel charges fixed latency
-            per transfer, and int32 2-D is its fast path): column 0 is the
-            line length; the rest is the class-id row — four uint8 ids per
-            int32 when the partition fits a byte (4x less h2d volume),
-            plain int32 ids otherwise. Output: one uint8 buffer
-              n_cand[4] ‖ n_matched[4] ‖ matched caller-row idx[4E] ‖
-              matched packed rule rows [E * nf8] ‖ always-rule bits [B * na8].
-            A single buffer = a single device→host pull — the tunnel charges
-            ~65 ms of fixed latency per pull regardless of size, so the
-            sparse result must come back in one piece (and overlapped, see
-            submit/collect). Two compaction levels: stage 1's factor gate
-            selects K candidate lines for stage 2, and only candidates that
-            actually MATCHED a rule (typically a few %) are shipped back.
-            Length-sort, transpose, unpack, and the sorted→caller index
-            mapping all happen on device: the host does no O(B·L) work."""
+        def core(cls_and_lens):
             lens_raw = cls_and_lens[:, 0]                        # [B]
             if packed_in:
                 words = cls_and_lens[:, 1 : 1 + L4]              # [B, L4]
@@ -489,23 +509,66 @@ class FusedPrefilter:
             rows = jnp.take(m2p, midx, axis=0) & (
                 mvalid[:, None] * jnp.uint8(0xFF)
             )
-            idx_caller = jnp.take(order, jnp.take(idx, midx))    # caller rows
-            idx_caller = jnp.where(mvalid, idx_caller, -1)
-            parts = [
-                ((n_cand[None] >> shifts) & 0xFF).astype(jnp.uint8),
-                ((n_m[None] >> shifts) & 0xFF).astype(jnp.uint8),
-                ((idx_caller[:, None] >> shifts[None, :]) & 0xFF)
-                .astype(jnp.uint8).reshape(-1),
-                rows.reshape(-1),
-            ]
+            # caller rows for ALL candidate slots (K-domain, B = invalid)
+            idx_caller_k = jnp.where(
+                valid, jnp.take(order, idx), jnp.int32(B)
+            )
+            # ...and for the matched-row compaction (E-domain, -1 = invalid)
+            idx_caller = jnp.where(
+                mvalid, jnp.take(idx_caller_k, midx), -1
+            )
+            ab_caller = None
             if n_always:
                 sel = (acc1[a_word, :] & a_mask[:, None]) != 0   # [n_abr, B]
                 ab = jnp.zeros((n_always, acc1.shape[1]), dtype=jnp.uint8)
                 ab = ab.at[a_rule].max(sel.astype(jnp.uint8))
-                # back to caller row order before packing
                 ab_caller = jnp.zeros_like(ab.T).at[order].set(ab.T)
+            return {
+                "lens_raw": lens_raw, "n_cand": n_cand, "n_m": n_m,
+                "m2p": m2p, "rows": rows, "idx_caller": idx_caller,
+                "idx_caller_k": idx_caller_k, "ab_caller": ab_caller,
+            }
+
+        return core
+
+    def _fused(self, B: int, L_p: int):
+        key = (B, L_p)
+        hit = self._fns.get(key)
+        if hit is not None:
+            return hit
+        block, K, E = self.capacities(B)
+        core = self._match_core(B, L_p, K, E, block)
+        n_always = self.plan.n_always
+        shifts = jnp.asarray([0, 8, 16, 24], dtype=jnp.int32)
+
+        @jax.jit
+        def fused(cls_and_lens):
+            """One int32 input transfer (the tunnel charges fixed latency
+            per transfer, and int32 2-D is its fast path — see
+            _match_core for the input layout) → one uint8 buffer:
+              n_cand[4] ‖ n_matched[4] ‖ matched caller-row idx[4E] ‖
+              matched packed rule rows [E * nf8] ‖ always-rule bits [B * na8].
+            A single buffer = a single device→host pull — the tunnel charges
+            ~65 ms of fixed latency per pull regardless of size, so the
+            sparse result must come back in one piece (and overlapped, see
+            submit/collect). Two compaction levels: stage 1's factor gate
+            selects K candidate lines for stage 2, and only candidates that
+            actually MATCHED a rule (typically a few %) are shipped back.
+            Length-sort, transpose, unpack, and the sorted→caller index
+            mapping all happen on device: the host does no O(B·L) work."""
+            c = core(cls_and_lens)
+            parts = [
+                ((c["n_cand"][None] >> shifts) & 0xFF).astype(jnp.uint8),
+                ((c["n_m"][None] >> shifts) & 0xFF).astype(jnp.uint8),
+                ((c["idx_caller"][:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                c["rows"].reshape(-1),
+            ]
+            if n_always:
                 parts.append(
-                    jnp.packbits(ab_caller.astype(jnp.bool_), axis=1).reshape(-1)
+                    jnp.packbits(
+                        c["ab_caller"].astype(jnp.bool_), axis=1
+                    ).reshape(-1)
                 )
             return jnp.concatenate(parts)
 
@@ -526,30 +589,7 @@ class FusedPrefilter:
         cls_ids = np.asarray(cls_ids, dtype=np.int32)
         lens = np.asarray(lens, dtype=np.int32)
         B = cls_ids.shape[0]
-        block = self._block_for(max(_MIN_BUCKET, B))
-        Bp = max(block, -(-max(1, B) // block) * block)
-        cols = self._cols
-        max_len = int(lens.max()) if B else 0
-        L_p = max(cols, min(
-            -(-cls_ids.shape[1] // cols) * cols,
-            -(-max(1, max_len) // max(32, cols)) * max(32, cols),
-        ))
-        Lc = min(cls_ids.shape[1], L_p)
-        if self._pack_input:
-            L4 = -(-L_p // 4)
-            combined = np.zeros((Bp, 1 + L4), dtype=np.int32)
-            if B:
-                combined[:B, 0] = lens
-                # write class ids straight into combined's byte view (LE
-                # lanes; bytes 0-3 of each row are the lens int32) — no
-                # intermediate buffer, one 4x-smaller copy total
-                v = combined.view(np.uint8).reshape(Bp, (1 + L4) * 4)
-                v[:B, 4 : 4 + Lc] = cls_ids[:, :Lc]
-        else:
-            combined = np.zeros((Bp, 1 + L_p), dtype=np.int32)
-            if B:
-                combined[:B, 0] = lens
-                combined[:B, 1 : 1 + Lc] = cls_ids[:, :Lc]
+        combined, Bp, L_p = self._assemble(cls_ids, lens)
         fn, K, E = self._fused(Bp, L_p)
         buf = fn(jnp.asarray(combined))
         try:

@@ -282,7 +282,12 @@ class TpuMatcher(Matcher):
                 else:
                     pf_backend = "xla"
                 try:
-                    self._prefilter = FusedPrefilter(plan, pf_backend)
+                    self._prefilter = FusedPrefilter(
+                        plan, pf_backend,
+                        cand_frac=getattr(
+                            config, "matcher_prefilter_cand_frac", 0.125
+                        ),
+                    )
                 except pallas_nfa.PallasUnsupported as e:
                     log.info("prefilter unavailable (%s); single-stage", e)
 
@@ -291,6 +296,24 @@ class TpuMatcher(Matcher):
         # whole ruleset per line (regex_rate_limiter.go:175-211 order)
         self._rule_order_cache: Dict[str, np.ndarray] = {}
         self._global_order_arr = np.asarray(self._global_idx, dtype=np.int64)
+
+        # fully-fused matcher+windows pipeline: one device dispatch per
+        # batch when both the fused prefilter and device windows are on and
+        # every rule is device-decidable (host-fallback rules need the
+        # classic bitmap path)
+        self._fw_pipeline = None
+        if (
+            self.device_windows is not None
+            and self._prefilter is not None
+            and not self._host_rule_idx
+        ):
+            from banjax_tpu.matcher.fused_windows import FusedWindowsPipeline
+
+            self._fw_pipeline = FusedWindowsPipeline(
+                self._prefilter, self.device_windows, self._active_table,
+                self.compiled.n_rules,
+            )
+            log.info("fused matcher+windows pipeline active")
 
     # ---- Matcher API ----
 
@@ -394,7 +417,23 @@ class TpuMatcher(Matcher):
         if not work:
             return results
 
-        # 2. device match bitmap for all matchable lines
+        # 2a. fully-fused pipeline: match + window apply in ONE device
+        #     dispatch (matcher/fused_windows.py) — no dense bitmap ever
+        #     crosses the host boundary. Eligible when every rule is
+        #     device-decidable and no line in the batch needs host eval.
+        if self.device_windows is not None and self._fw_pipeline is not None:
+            if pre_encoded is not None:
+                cls_ids, lens, host_eval = pre_encoded
+            else:
+                cls_ids, lens, host_eval = encode_for_match(
+                    self.compiled, [p.rest for _, p in work], self._max_len
+                )
+                pre_encoded = (cls_ids, lens, host_eval)
+            if not host_eval.any():
+                self._consume_via_pipeline(work, cls_ids, lens, results)
+                return results
+
+        # 2b. device match bitmap for all matchable lines
         bits = self._match_bits([p for _, p in work], pre_encoded)
 
         # 3a. device window pass: fold the whole batch of match events into
@@ -428,60 +467,173 @@ class TpuMatcher(Matcher):
     def close(self) -> None:
         """No buffered state: consume_lines is synchronous per batch."""
 
-    def _apply_device_windows(self, work, bits, results) -> None:
-        """Device window path: one _apply_step per batch, then host-side
-        replay of the per-event outcomes (same observable sequence as the
-        host pass: rule_results in per-site-then-global order, Banner side
-        effects per exceeded event)."""
+    def _with_window_slots(self, work, split, apply_fn, results) -> None:
+        """Shared scaffolding for every device-windows consume path: slot
+        allocation with recursive batch split when it refuses, per-line
+        ts/host prep, and the pin-lifecycle contract. `apply_fn(work,
+        slots, ts_s, ts_ns, host_idx, results)` OWNS the pins from the
+        moment it is entered and must release them exactly once on every
+        path; any failure before that hand-off releases them here.
+        `split(lo, hi)` returns the work-aligned payload slices for a
+        recursive half-batch."""
         from banjax_tpu.matcher.windows import split_ns
 
-        slots = self.device_windows.slots_for_ips([p.ip for _, p in work])
+        dw = self.device_windows
+        slots = dw.slots_for_ips([p.ip for _, p in work])
         if slots is None:
             if len(work) <= 1:
-                # a lone line can only fail allocation if every slot is
-                # pinned by in-flight batches — don't recurse forever
                 log.error(
-                    "device-windows slot allocation failed for a single line "
-                    "(capacity=%d, all slots pinned); dropping line",
-                    self.device_windows.capacity,
+                    "device-windows slot allocation failed for a single "
+                    "line (capacity=%d, all slots pinned); dropping line",
+                    dw.capacity,
                 )
                 for i, _ in work:
                     results[i].error = True
                 return
-            # more distinct IPs than free+evictable slots: splitting the
-            # batch lets earlier lines' events land before their slots can
-            # be evicted for later lines (single-line batches always fit)
             mid = max(1, len(work) // 2)
-            self._apply_device_windows(work[:mid], bits[:mid], results)
-            self._apply_device_windows(work[mid:], bits[mid:], results)
+            self._with_window_slots(work[:mid], *split(0, mid), results)
+            self._with_window_slots(
+                work[mid:], *split(mid, len(work)), results
+            )
             return
-        # pins must be released exactly once: apply_bitmap owns them from
-        # the moment it's entered (its finally releases on every path); any
-        # failure BEFORE that (e.g. an unrepresentable timestamp in
-        # split_ns) must release here or the slots stay unevictable forever
         handed_off = False
         try:
-            ts_s, ts_ns = split_ns(np.array([p.timestamp_ns for _, p in work]))
+            ts_s, ts_ns = split_ns(
+                np.array([p.timestamp_ns for _, p in work])
+            )
             host_idx = np.array(
-                [self._host_row.get(p.host, 0) for _, p in work], dtype=np.int32
+                [self._host_row.get(p.host, 0) for _, p in work],
+                dtype=np.int32,
             )
             handed_off = True
-            events = self.device_windows.apply_bitmap(
-                bits, slots, ts_s, ts_ns, self._active_table, host_idx
-            )
+            apply_fn(work, slots, ts_s, ts_ns, host_idx, results)
         except Exception:
             if not handed_off:
-                self.device_windows.release_pins(slots)
+                dw.release_pins(slots)
             raise
-        evmap = {(e.line, e.rule_id): e for e in events}
 
-        row_any = bits.any(axis=1)
-        for row, (i, p) in enumerate(work):
-            if not row_any[row]:
-                continue
+    def _consume_via_pipeline(self, work, cls_ids, lens, results) -> None:
+        """Fully-fused path: match + window apply in one device dispatch.
+
+        Chunks by matcher_batch_lines (one tailer burst must not compile
+        an outsized one-off program), splits like the classic path when
+        slot allocation refuses, and on a candidate-capacity overflow
+        (result.events is None) recomputes the bitmap single-stage and
+        replays through the classic apply — the device state was left
+        untouched by the gate."""
+        if len(work) > self._max_batch:
+            for s in range(0, len(work), self._max_batch):
+                e = s + self._max_batch
+                self._consume_via_pipeline(
+                    work[s:e], cls_ids[s:e], lens[s:e], results
+                )
+            return
+
+        def make(cls_c, lens_c):
+            """→ (split, apply_fn) over this chunk's encode payload."""
+
+            def apply_fn(work_c, slots, ts_s, ts_ns, host_idx, results_c):
+                dw = self.device_windows
+                pend = None
+                try:
+                    pend = self._fw_pipeline.submit(
+                        cls_c, lens_c, slots, ts_s, ts_ns, host_idx
+                    )
+                    res = self._fw_pipeline.collect(pend)
+                except Exception:
+                    # the pipeline has no finally of its own pre-decode;
+                    # pins die here rather than leak (release is
+                    # idempotent-per-batch: collect's paths either ran to
+                    # completion or never released)
+                    dw.release_pins(slots)
+                    raise
+                if res.events is None:
+                    # candidate overflow: full-NFA bitmap, classic apply
+                    # (which releases the pins the pipeline left held)
+                    try:
+                        n = len(work_c)
+                        bits = self._single_stage_bits(
+                            n, cls_c, lens_c, np.zeros(n, dtype=bool),
+                            np.arange(n),
+                        )
+                    except Exception:
+                        dw.release_pins(slots)
+                        raise
+                    events = dw.apply_bitmap(
+                        bits, slots, ts_s, ts_ns, self._active_table,
+                        host_idx,
+                    )
+                    self._replay_window_events(
+                        work_c, bits, None, events, results_c
+                    )
+                    return
+                if res.matched_bits is not None:
+                    bits = None
+                    sparse = (
+                        res.matched_rows, res.matched_bits, res.always_bits
+                    )
+                else:
+                    bits = np.asarray(res.bits_dev)[: len(work_c)]
+                    sparse = None
+                self._replay_window_events(
+                    work_c, bits, sparse, res.events, results_c
+                )
+
+            def split(lo, hi):
+                return make(cls_c[lo:hi], lens_c[lo:hi])
+
+            return split, apply_fn
+
+        self._with_window_slots(work, *make(cls_ids, lens), results)
+
+    def _sparse_row_sets(self, n, sparse):
+        """Per-row matched rule-id sets from the pipeline's sparse result."""
+        matched_rows, matched_bits, always_bits = sparse
+        plan = self._prefilter.plan
+        row_ids: Dict[int, set] = {}
+        if matched_rows is not None and len(matched_rows):
+            unpacked = np.unpackbits(
+                matched_bits, axis=1, count=plan.stage2.n_rules
+            )
+            for k, row in enumerate(matched_rows):
+                ids = plan.f_idx[np.flatnonzero(unpacked[k])]
+                if len(ids):
+                    row_ids.setdefault(int(row), set()).update(
+                        int(x) for x in ids
+                    )
+        if always_bits is not None and plan.n_always:
+            ab = np.unpackbits(
+                always_bits[:n], axis=1, count=plan.n_always
+            )
+            for row, col in zip(*np.nonzero(ab)):
+                row_ids.setdefault(int(row), set()).add(
+                    int(plan.a_idx[col])
+                )
+        return row_ids
+
+    def _replay_window_events(
+        self, work, bits, sparse, events, results
+    ) -> None:
+        """Replay window events + match bookkeeping into ConsumeLineResults
+        (per-site-then-global rule order, Banner per exceeded event) —
+        shared by the classic bitmap path and the fused pipeline."""
+        evmap = {(e.line, e.rule_id): e for e in events}
+        if sparse is not None:
+            row_ids = self._sparse_row_sets(len(work), sparse)
+            row_iter = sorted(row_ids)
+        else:
+            row_any = bits.any(axis=1)
+            row_iter = (r for r in range(len(work)) if row_any[r])
+        for row in row_iter:
+            i, p = work[row]
             ord_arr = self._rule_order_np(p.host)
+            if sparse is not None:
+                ids = row_ids[row]
+                matched = [x for x in ord_arr if x in ids]
+            else:
+                matched = ord_arr[bits[row, ord_arr] != 0]
             try:
-                for idx in ord_arr[bits[row, ord_arr] != 0]:
+                for idx in matched:
                     _, rule = self._entries[idx]
                     result = RuleResult(rule_name=rule.rule, regex_match=True)
                     if rule.hosts_to_skip.get(p.host):
@@ -506,6 +658,26 @@ class TpuMatcher(Matcher):
             except Exception:  # noqa: BLE001 — a failing effector loses one line, not the batch
                 log.exception("error applying rules to log line")
                 results[i].error = True
+
+    def _apply_device_windows(self, work, bits, results) -> None:
+        """Classic device window path: apply_bitmap per batch, then replay
+        (shared scaffolding handles slot allocation/split/pin lifecycle)."""
+
+        def make(bits_c):
+            def apply_fn(work_c, slots, ts_s, ts_ns, host_idx, results_c):
+                events = self.device_windows.apply_bitmap(
+                    bits_c, slots, ts_s, ts_ns, self._active_table, host_idx
+                )
+                self._replay_window_events(
+                    work_c, bits_c, None, events, results_c
+                )
+
+            def split(lo, hi):
+                return make(bits_c[lo:hi])
+
+            return split, apply_fn
+
+        self._with_window_slots(work, *make(bits), results)
 
     # ---- internals ----
 

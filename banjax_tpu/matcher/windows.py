@@ -109,12 +109,7 @@ def _count_events(bits, active_table, host_idx):
     return fire.sum(dtype=jnp.int32)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_rules", "max_events"),
-    donate_argnums=(0,),
-)
-def _apply_step(
+def _apply_core(
     state: DeviceWindowState,
     bits: jnp.ndarray,         # [B, R] uint8/bool match bitmap (device)
     active_table: jnp.ndarray,  # [H, R] bool — rule applicable & not hosts_to_skip
@@ -128,8 +123,15 @@ def _apply_step(
     *,
     n_rules: int,
     max_events: int,
+    gate=None,                 # scalar bool: False drops EVERY state write
 ):
-    # evictions/restores run in _maintenance_step BEFORE this step
+    """The traceable window-apply body — composable inside a larger jit
+    (the fused matcher+windows pipeline) as well as the standalone
+    _apply_step below. Caller guarantees evictions/restores already ran
+    (_maintenance_step). `gate` supports overflow handling under buffer
+    donation: when False, all scatters drop (indices pushed out of range)
+    so the donated state passes through bit-identical and the caller can
+    rerun the batch through the splitting path — no state copy needed."""
     cap_r = state.hits.shape[0]
     valid = state.valid
     ip_seen = state.ip_seen
@@ -212,13 +214,15 @@ def _apply_step(
     next_key = jnp.concatenate([key_s[1:], jnp.full((1,), -2, dtype=key_s.dtype)])
     is_last = (key_s != next_key) & ~pad_s
     wb_key = jnp.where(is_last, key_s, jnp.int32(cap_r))  # drop non-last
+    seen_idx = jnp.where(pad, state.ip_seen.shape[0], slot)
+    if gate is not None:
+        wb_key = jnp.where(gate, wb_key, jnp.int32(cap_r))
+        seen_idx = jnp.where(gate, seen_idx, state.ip_seen.shape[0])
     hits = state.hits.at[wb_key].set(f_hits, mode="drop")
     start_s = state.start_s.at[wb_key].set(f_ss, mode="drop")
     start_ns = state.start_ns.at[wb_key].set(f_sns, mode="drop")
     valid = valid.at[wb_key].set(True, mode="drop")
-    ip_seen = ip_seen.at[jnp.where(pad, state.ip_seen.shape[0], slot)].set(
-        True, mode="drop"
-    )
+    ip_seen = ip_seen.at[seen_idx].set(True, mode="drop")
 
     new_state = DeviceWindowState(
         hits=hits, start_s=start_s, start_ns=start_ns, valid=valid, ip_seen=ip_seen
@@ -236,6 +240,19 @@ def _apply_step(
         "start_ns": f_sns,
     }
     return new_state, out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_rules", "max_events"),
+    donate_argnums=(0,),
+)
+def _apply_step(state, bits, active_table, host_idx, slot_ids, ts_s, ts_ns,
+                limits, iv_s, iv_ns, *, n_rules, max_events):
+    return _apply_core(
+        state, bits, active_table, host_idx, slot_ids, ts_s, ts_ns,
+        limits, iv_s, iv_ns, n_rules=n_rules, max_events=max_events,
+    )
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

@@ -1,0 +1,134 @@
+"""Fused matcher+windows pipeline (matcher/fused_windows.py): one device
+dispatch per batch, byte-identical to the serial CPU reference — including
+every overflow fallback, which must leave the device window state untouched
+(the write gate) and still produce identical output via the classic path."""
+
+import time
+
+import numpy as np
+import pytest
+import yaml
+
+import bench
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from banjax_tpu.matcher.runner import TpuMatcher
+from tests.mock_banner import MockBanner
+
+
+def _rules_yaml(patterns, hits=3, interval=20):
+    return yaml.safe_dump({
+        "regexes_with_rates": [
+            {"rule": f"r{i}", "regex": p, "interval": interval,
+             "hits_per_interval": hits, "decision": "nginx_block"}
+            for i, p in enumerate(patterns)
+        ]
+    })
+
+
+def _mk(cls, yaml_text, **ov):
+    cfg = config_from_yaml_text(yaml_text)
+    for k, v in ov.items():
+        setattr(cfg, k, v)
+    banner = MockBanner()
+    return cls(cfg, banner, StaticDecisionLists(cfg), RegexRateLimitStates()), banner
+
+
+def _key(res):
+    return [
+        (x.rule_name, x.regex_match, x.skip_host, x.seen_ip,
+         None if x.rate_limit_result is None else
+         (int(x.rate_limit_result.match_type), x.rate_limit_result.exceeded))
+        for x in res.rule_results
+    ]
+
+
+def _drive_pair(patterns, lines, now, **tpu_overrides):
+    y = _rules_yaml(patterns)
+    cpu, cb = _mk(CpuMatcher, y)
+    tpu, tb = _mk(TpuMatcher, y, matcher_device_windows=True, **tpu_overrides)
+    want = [cpu.consume_line(l, now) for l in lines]
+    batch = tpu_overrides.get("matcher_batch_lines", 128)
+    got = []
+    for s in range(0, len(lines), batch):
+        got.extend(tpu.consume_lines(lines[s : s + batch], now))
+    assert [_key(a) for a in want] == [_key(b) for b in got]
+    assert cb.bans == tb.bans
+    assert cb.regex_ban_logs == tb.regex_ban_logs
+    return tpu
+
+
+def _lines(patterns, n, now, attack_rate, n_ips=24, seed=3):
+    rests = bench.generate_lines(n, patterns, seed=seed,
+                                 attack_rate=attack_rate)
+    return [
+        f"{now + i * 0.0005:.6f} 10.9.{i % n_ips}.1 {r}"
+        for i, r in enumerate(rests)
+    ]
+
+
+def test_pipeline_engages_and_matches_oracle():
+    patterns = bench.generate_rules(60, seed=31) + [r".*", r"^$"]
+    now = time.time()
+    lines = _lines(patterns[:-2], 300, now, attack_rate=0.05) + [
+        f"{now:.6f} 10.9.0.1 "  # empty rest: ^$ matches
+    ]
+    tpu = _drive_pair(
+        patterns, lines, now + 1,
+        matcher_batch_lines=128, matcher_prefilter_cand_frac=0.5,
+    )
+    assert tpu._fw_pipeline is not None
+    assert tpu._fw_pipeline.fused_batches > 0
+    assert tpu._fw_pipeline.fallback_batches == 0
+
+
+def test_candidate_overflow_falls_back_identically():
+    """All-matching traffic exceeds the candidate capacity: the pipeline's
+    dense bitmap is incomplete, so the batch recomputes single-stage and
+    replays classic — output still identical, state never corrupted."""
+    patterns = bench.generate_rules(40, seed=32)
+    now = time.time()
+    lines = _lines(patterns, 200, now, attack_rate=1.0)
+    tpu = _drive_pair(
+        patterns, lines, now + 1,
+        matcher_batch_lines=64, matcher_prefilter_cand_frac=1.0 / 64,
+    )
+    assert tpu._fw_pipeline is not None
+    assert tpu._fw_pipeline.fallback_batches > 0
+
+
+def test_event_overflow_falls_back_identically():
+    """More window events than max_events: the gate drops every state
+    write, and the classic apply (which splits) replays the batch."""
+    patterns = bench.generate_rules(30, seed=33) + [r".*"]
+    now = time.time()
+    lines = _lines(patterns[:-1], 256, now, attack_rate=0.1)
+    y = _rules_yaml(patterns)
+    cpu, cb = _mk(CpuMatcher, y)
+    tpu, tb = _mk(
+        TpuMatcher, y, matcher_device_windows=True,
+        matcher_batch_lines=256, matcher_prefilter_cand_frac=1.0,
+    )
+    # shrink max_events below the per-batch event count (every line fires .*)
+    tpu.device_windows.max_events = max(tpu.compiled.n_rules, 64)
+    want = [cpu.consume_line(l, now + 1) for l in lines]
+    got = tpu.consume_lines(lines, now + 1)
+    assert [_key(a) for a in want] == [_key(b) for b in got]
+    assert cb.bans == tb.bans
+    assert tpu._fw_pipeline.fallback_batches > 0
+
+
+def test_pipeline_with_eviction_churn():
+    """Slot eviction/spill/restore under the pipeline stays lossless."""
+    patterns = bench.generate_rules(25, seed=34)
+    now = time.time()
+    lines = _lines(patterns, 400, now, attack_rate=0.3, n_ips=60, seed=8)
+    tpu = _drive_pair(
+        patterns, lines, now + 1,
+        matcher_batch_lines=64, matcher_prefilter_cand_frac=1.0,
+        matcher_window_capacity=16,
+    )
+    assert tpu.device_windows.eviction_count > 0
+    assert tpu._fw_pipeline.fused_batches > 0
