@@ -265,14 +265,24 @@ class FusedWindowsPipeline:
         with self._seq_cv:
             while self._collect_seq != p.seq:
                 self._seq_cv.wait()
+        # pin ownership: exactly one release on every path. _collect_inner
+        # moves ownership forward ('released' after its own release,
+        # 'applied' once apply_bitmap — which releases internally — is
+        # entered, 'caller' when returning pins_held=True); an exception
+        # while still 'collect' releases here.
+        owner = ["collect"]
         try:
-            return self._collect_inner(p)
+            return self._collect_inner(p, owner)
+        except Exception:
+            if owner[0] == "collect":
+                self.windows.release_pins(p.slots)
+            raise
         finally:
             with self._seq_cv:
                 self._collect_seq += 1
                 self._seq_cv.notify_all()
 
-    def _collect_inner(self, p: _PendingBatch) -> "FusedWindowsResult":
+    def _collect_inner(self, p: _PendingBatch, owner) -> "FusedWindowsResult":
         wnd = self.windows
         max_events = wnd.max_events
         E = p.E
@@ -319,45 +329,45 @@ class FusedWindowsPipeline:
 
         if ok:
             self.fused_batches += 1
-            try:
-                live = np.flatnonzero(ev_rule >= 0)
-                events = [
-                    WindowEvent(
-                        line=int(ev_line[k]),
-                        rule_id=int(ev_rule[k]),
-                        match_type=RateLimitMatchType(int(ev_mtype[k])),
-                        exceeded=bool(ev_exc[k]),
-                        seen_ip=bool(ev_seen[k]),
-                    )
-                    for k in live
-                ]
-                # shadow update mirrors _apply_bitmap_inner: key-sorted
-                # event order, last write per (ip, rule) wins
-                from collections import OrderedDict
-
-                with wnd._lock:
-                    for k in live:
-                        ip = wnd._slot_ip.get(int(p.slots[int(ev_line[k])]))
-                        if ip is None:
-                            continue
-                        od = wnd._shadow.setdefault(ip, OrderedDict())
-                        od[int(ev_rule[k])] = (
-                            int(ev_hits[k]), int(ev_ss[k]), int(ev_sns[k])
-                        )
-                events.sort(key=lambda e: (e.line, e.rule_id))
-                m_rows, m_bits = sparse()
-                return FusedWindowsResult(
-                    events=events, matched_rows=m_rows,
-                    matched_bits=m_bits, always_bits=always_bits,
-                    bits_dev=p.bits_dev, pins_held=False,
+            live = np.flatnonzero(ev_rule >= 0)
+            events = [
+                WindowEvent(
+                    line=int(ev_line[k]),
+                    rule_id=int(ev_rule[k]),
+                    match_type=RateLimitMatchType(int(ev_mtype[k])),
+                    exceeded=bool(ev_exc[k]),
+                    seen_ip=bool(ev_seen[k]),
                 )
-            finally:
-                wnd.release_pins(p.slots)
+                for k in live
+            ]
+            # shadow update mirrors _apply_bitmap_inner: key-sorted
+            # event order, last write per (ip, rule) wins
+            from collections import OrderedDict
+
+            with wnd._lock:
+                for k in live:
+                    ip = wnd._slot_ip.get(int(p.slots[int(ev_line[k])]))
+                    if ip is None:
+                        continue
+                    od = wnd._shadow.setdefault(ip, OrderedDict())
+                    od[int(ev_rule[k])] = (
+                        int(ev_hits[k]), int(ev_ss[k]), int(ev_sns[k])
+                    )
+            events.sort(key=lambda e: (e.line, e.rule_id))
+            m_rows, m_bits = sparse()
+            owner[0] = "released"
+            wnd.release_pins(p.slots)
+            return FusedWindowsResult(
+                events=events, matched_rows=m_rows,
+                matched_bits=m_bits, always_bits=always_bits,
+                bits_dev=p.bits_dev, pins_held=False,
+            )
 
         self.fallback_batches += 1
         if n_cand > p.K:
             # incomplete bitmap: caller recomputes single-stage and runs
             # apply_bitmap with p.slots (pins stay held until then)
+            owner[0] = "caller"
             return FusedWindowsResult(
                 events=None, matched_rows=None, matched_bits=None,
                 always_bits=None, bits_dev=None, pins_held=True,
@@ -365,6 +375,7 @@ class FusedWindowsPipeline:
         # bitmap complete: classic replay (splits, updates shadow,
         # releases pins); slice off the padding rows so the row count
         # matches the unpadded slots/ts vectors
+        owner[0] = "applied"
         events = wnd.apply_bitmap(
             p.bits_dev[: p.B], p.slots, p.ts_s, p.ts_ns, self.active_table,
             p.host_idx,

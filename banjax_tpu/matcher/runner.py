@@ -520,7 +520,18 @@ class TpuMatcher(Matcher):
         slot allocation refuses, and on a candidate-capacity overflow
         (result.events is None) recomputes the bitmap single-stage and
         replays through the classic apply — the device state was left
-        untouched by the gate."""
+        untouched by the gate.
+
+        Chunks apply STRICTLY in order, each fully collected before the
+        next submits. Cross-chunk overlap (submitting N+1 while N's pull
+        is in flight) is deliberately NOT done here: if chunk N takes an
+        overflow fallback, its re-apply would land on the device stream
+        AFTER N+1's already-submitted fused apply — out-of-order window
+        updates, missed bans, and a stale shadow. Overlapping safely needs
+        the match and window-apply programs split so applies dispatch only
+        once the prior chunk's overflow flags are resolved (PERF.md
+        "path to 5M" 3c); the stateless fused matcher path already
+        pipelines freely."""
         if len(work) > self._max_batch:
             for s in range(0, len(work), self._max_batch):
                 e = s + self._max_batch
@@ -534,49 +545,16 @@ class TpuMatcher(Matcher):
 
             def apply_fn(work_c, slots, ts_s, ts_ns, host_idx, results_c):
                 dw = self.device_windows
-                pend = None
                 try:
                     pend = self._fw_pipeline.submit(
                         cls_c, lens_c, slots, ts_s, ts_ns, host_idx
                     )
-                    res = self._fw_pipeline.collect(pend)
                 except Exception:
-                    # the pipeline has no finally of its own pre-decode;
-                    # pins die here rather than leak (release is
-                    # idempotent-per-batch: collect's paths either ran to
-                    # completion or never released)
                     dw.release_pins(slots)
                     raise
-                if res.events is None:
-                    # candidate overflow: full-NFA bitmap, classic apply
-                    # (which releases the pins the pipeline left held)
-                    try:
-                        n = len(work_c)
-                        bits = self._single_stage_bits(
-                            n, cls_c, lens_c, np.zeros(n, dtype=bool),
-                            np.arange(n),
-                        )
-                    except Exception:
-                        dw.release_pins(slots)
-                        raise
-                    events = dw.apply_bitmap(
-                        bits, slots, ts_s, ts_ns, self._active_table,
-                        host_idx,
-                    )
-                    self._replay_window_events(
-                        work_c, bits, None, events, results_c
-                    )
-                    return
-                if res.matched_bits is not None:
-                    bits = None
-                    sparse = (
-                        res.matched_rows, res.matched_bits, res.always_bits
-                    )
-                else:
-                    bits = np.asarray(res.bits_dev)[: len(work_c)]
-                    sparse = None
-                self._replay_window_events(
-                    work_c, bits, sparse, res.events, results_c
+                self._finish_pipeline_chunk(
+                    work_c, cls_c, lens_c, slots, ts_s, ts_ns, host_idx,
+                    pend, results_c,
                 )
 
             def split(lo, hi):
@@ -585,6 +563,40 @@ class TpuMatcher(Matcher):
             return split, apply_fn
 
         self._with_window_slots(work, *make(cls_ids, lens), results)
+
+    def _finish_pipeline_chunk(
+        self, work, cls_ids, lens, slots, ts_s, ts_ns, host_idx, pend,
+        results,
+    ) -> None:
+        """Collect + replay one submitted pipeline chunk. collect() owns
+        the pins and releases exactly once on every path — including its
+        own exceptions — EXCEPT when it returns pins_held=True (candidate
+        overflow), where ownership transfers here."""
+        dw = self.device_windows
+        res = self._fw_pipeline.collect(pend)
+        if res.events is None:
+            # candidate overflow: full-NFA bitmap, classic apply (which
+            # releases the pins the pipeline left held)
+            try:
+                n = len(work)
+                bits = self._single_stage_bits(
+                    n, cls_ids, lens, np.zeros(n, dtype=bool), np.arange(n)
+                )
+            except Exception:
+                dw.release_pins(slots)
+                raise
+            events = dw.apply_bitmap(
+                bits, slots, ts_s, ts_ns, self._active_table, host_idx
+            )
+            self._replay_window_events(work, bits, None, events, results)
+            return
+        if res.matched_bits is not None:
+            bits = None
+            sparse = (res.matched_rows, res.matched_bits, res.always_bits)
+        else:
+            bits = np.asarray(res.bits_dev)[: len(work)]
+            sparse = None
+        self._replay_window_events(work, bits, sparse, res.events, results)
 
     def _sparse_row_sets(self, n, sparse):
         """Per-row matched rule-id sets from the pipeline's sparse result."""

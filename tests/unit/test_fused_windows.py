@@ -120,6 +120,45 @@ def test_event_overflow_falls_back_identically():
     assert tpu._fw_pipeline.fallback_batches > 0
 
 
+def test_multi_chunk_burst_pipelines_identically():
+    """One consume_lines call larger than matcher_batch_lines goes through
+    the cross-chunk pipelined submit path (chunk N+1 in flight while N
+    collects) — output identical to the serial reference."""
+    patterns = bench.generate_rules(30, seed=35)
+    now = time.time()
+    lines = _lines(patterns, 400, now, attack_rate=0.1, n_ips=40, seed=9)
+    y = _rules_yaml(patterns)
+    cpu, cb = _mk(CpuMatcher, y)
+    tpu, tb = _mk(
+        TpuMatcher, y, matcher_device_windows=True,
+        matcher_batch_lines=64, matcher_prefilter_cand_frac=1.0,
+    )
+    want = [cpu.consume_line(l, now + 1) for l in lines]
+    got = tpu.consume_lines(lines, now + 1)  # ONE call: 7 chunks pipeline
+    assert [_key(a) for a in want] == [_key(b) for b in got]
+    assert cb.bans == tb.bans
+    assert tpu._fw_pipeline.fused_batches >= 6
+
+
+def test_multi_chunk_with_tight_slot_capacity():
+    """Pipelined chunks + a slot capacity too small for two chunks' pins:
+    the drain-and-retry path must keep output identical."""
+    patterns = bench.generate_rules(20, seed=36)
+    now = time.time()
+    lines = _lines(patterns, 300, now, attack_rate=0.2, n_ips=90, seed=10)
+    y = _rules_yaml(patterns)
+    cpu, cb = _mk(CpuMatcher, y)
+    tpu, tb = _mk(
+        TpuMatcher, y, matcher_device_windows=True,
+        matcher_batch_lines=64, matcher_prefilter_cand_frac=1.0,
+        matcher_window_capacity=48,
+    )
+    want = [cpu.consume_line(l, now + 1) for l in lines]
+    got = tpu.consume_lines(lines, now + 1)
+    assert [_key(a) for a in want] == [_key(b) for b in got]
+    assert cb.bans == tb.bans
+
+
 def test_pipeline_with_eviction_churn():
     """Slot eviction/spill/restore under the pipeline stays lossless."""
     patterns = bench.generate_rules(25, seed=34)
