@@ -1,24 +1,40 @@
-"""Fused matcher + device-windows pipeline: one device dispatch per batch.
+"""Fused matcher + device-windows pipeline, split into two device programs
+so chunks can OVERLAP without ever reordering window updates.
 
-Without this, the device-windows path round-trips the match bitmap through
-the host: the fused matcher pulls its sparse result down (~65 ms fixed
-tunnel latency), the runner reconstructs a dense [B, n_rules] bitmap, and
-apply_bitmap pushes those ~16 MB straight back up for the window scan —
-two transfers and an extra dispatch of pure overhead on the hot path
-(BASELINE.json configs[4]/[5], the live-stream shape).
+Why fused at all: with device windows on, the naive path round-trips the
+match bitmap through the host — the matcher pulls its sparse result down
+(~65 ms fixed tunnel latency per pull), the runner rebuilds a dense
+[B, n_rules] bitmap, and apply_bitmap pushes those ~16 MB back up for the
+window scan. Here the dense caller-order bitmap never exists on the host.
 
-Here the dense caller-order bitmap never exists on the host: the two-stage
-match (prefilter._match_core) and the window apply (windows._apply_core)
-trace into ONE jit. Per batch the host sends the combined class array plus
-four small per-line vectors (slots, ts_s, ts_ns, host row), and receives
-ONE buffer: overflow flags ‖ window events ‖ the sparse matched rows for
-ConsumeLineResult bookkeeping. The window state is donated through the
-dispatch; all three overflow conditions (candidates > K, matched rows > E,
-events > max_events) gate every state write OFF on device (windows
-_apply_core `gate`), so an overflowing batch leaves the counters
-bit-identical and the caller reruns it through the classic splitting path
-using the dense bitmap — which this program also returns as a
-device-resident output (free unless that fallback actually pulls it).
+Why two programs (PERF.md "path to 5M" 3c): a single fused program forces
+strict chunk serialization — if chunk N overflows (its state writes gated
+off), its classic re-apply would land on the device stream AFTER an
+already-submitted chunk N+1, reordering window updates. Splitting fixes it:
+
+  program A — MATCH (stateless): two-stage match (prefilter._match_core),
+    dense caller-order bitmap assembly, and ALL overflow flags — candidate
+    count, matched-row count, and the window-event count (it takes
+    host_idx + active_table precisely so the event count is known before
+    any state is touched). Outputs: one sparse host buffer (flags ‖
+    matched rows ‖ always-rule bits) and the device-resident bitmap.
+    A dispatches freely, any number of chunks ahead.
+
+  program B — APPLY (window state donated): the window segmented scan
+    (windows._apply_core) over A's bitmap. B for chunk i is dispatched
+    only after chunk i's A-flags are known ok AND every earlier chunk's
+    apply (B or classic fallback) has completed its dispatch — so
+    device-stream order equals log order, always. Overflowing chunks never
+    dispatch B: the caller drains all earlier chunks, then replays through
+    the classic splitting path (state untouched, output identical).
+
+Both pulls (A's sparse buffer, B's event buffer) are async and overlap
+later chunks' compute, hiding the tunnel's fixed d2h latency.
+
+Ordering machinery: submit() assigns a sequence number; resolve() and
+collect() each gate on it (resolve order = B dispatch order = device apply
+order; collect order = host-shadow write order). The shadow must absorb
+batches in device-apply order or an eviction could restore stale counters.
 
 Event order parity: bits are scattered into CALLER row order before the
 window apply, so the event compaction's row-major (line, rule) order — the
@@ -31,6 +47,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import threading
 from typing import List, Optional
 
 import jax
@@ -44,29 +61,67 @@ from banjax_tpu.decisions.rate_limit import RateLimitMatchType
 
 log = logging.getLogger(__name__)
 
+_SHIFTS = (0, 8, 16, 24)
+
 
 @dataclasses.dataclass
-class _PendingBatch:
-    buf: object            # uint8 result buffer (copy_to_host_async started)
-    bits_dev: object       # [B, n_rules] uint8 device-resident (fallback use)
-    slots: np.ndarray      # caller-order slot per line (pins held)
-    ts_s: np.ndarray
-    ts_ns: np.ndarray
-    host_idx: np.ndarray
+class _Pend:
+    """One chunk in flight. States: submitted → resolved → done, or
+    submitted → overflow → (caller fallback) → done."""
+
+    seq: int
+    sparse_buf: object     # program A's buffer (async pull in flight)
+    bits_dev: object       # [Bp, n_rules] uint8 device-resident
+    slots: np.ndarray      # caller-order, pins held
+    ts_s: np.ndarray       # padded to Bp
+    ts_ns: np.ndarray      # padded to Bp
+    host_idx: np.ndarray   # padded to Bp
     B: int                 # real rows
+    Bp: int
     K: int
     E: int
-    seq: int = 0           # submit order (collects must match it)
+    state: str = "submitted"
+    flags: Optional[np.ndarray] = None     # [4] after resolve
+    events_buf: object = None              # program B's buffer
+    # decoded at resolve (from the A pull)
+    matched_rows: Optional[np.ndarray] = None
+    matched_bits: Optional[np.ndarray] = None
+    always_bits: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass
+class FusedWindowsResult:
+    """Outcome of one collected chunk."""
+
+    events: List[WindowEvent]
+    matched_rows: Optional[np.ndarray]    # caller rows with >=1 stage-2 bit
+    matched_bits: Optional[np.ndarray]    # [len(matched_rows), nf8] packed
+    always_bits: Optional[np.ndarray]     # [B, na8] packed always-rule bits
+
+
+class PipelineOverflow(RuntimeError):
+    """resolve() found an overflow: the caller must finish this chunk via
+    the classic fallback (then call fallback_done)."""
+
+    def __init__(self, candidate_overflow: bool):
+        super().__init__(
+            "candidate capacity exceeded" if candidate_overflow
+            else "matched-row/event capacity exceeded"
+        )
+        # True: stage 2 never saw the excess lines — even the dense bitmap
+        # is incomplete and must be recomputed single-stage
+        self.candidate_overflow = candidate_overflow
 
 
 class FusedWindowsPipeline:
-    """Builds and runs the single-dispatch match+windows program.
+    """Built by TpuMatcher when the fused prefilter and device windows are
+    both active and every rule is device-decidable.
 
-    Constructed by TpuMatcher when both the fused prefilter and device
-    windows are active. submit() must be called with the windows slot pins
-    already held (slots_for_ips); collect() consumes the events, updates
-    the host shadow, and releases the pins — or runs the classic fallback
-    on overflow (which releases them itself)."""
+    Contract: submit in chunk order; resolve and collect each in that same
+    order (they gate on it). Pins are owned by the pipeline from submit()
+    until collect() completes — except after PipelineOverflow, where the
+    caller's fallback apply (which releases them) takes over, followed by
+    fallback_done() to release the order turns."""
 
     def __init__(self, prefilter: FusedPrefilter, windows: DeviceWindows,
                  active_table, n_rules: int):
@@ -74,7 +129,8 @@ class FusedWindowsPipeline:
         self.windows = windows
         self.active_table = jnp.asarray(active_table)
         self.n_rules = n_rules
-        self._fns = {}
+        self._match_fns = {}
+        self._apply_fns = {}
         plan = prefilter.plan
         self._f_idx = jnp.asarray(plan.f_idx, dtype=jnp.int32)
         self._a_idx = jnp.asarray(plan.a_idx, dtype=jnp.int32)
@@ -85,75 +141,66 @@ class FusedWindowsPipeline:
         self._ae = jnp.asarray(
             np.asarray(plan.stage1.empty_only[:na], dtype=np.uint8)
         )
-        # overflows observable in metrics
         self.fused_batches = 0
         self.fallback_batches = 0
-        # collect-order gate: the host shadow must absorb batches in the
-        # order their device applies ran (= submit order). Concurrent
-        # callers' collects serialize on this sequence — the same
-        # invariant windows._apply_bitmap_inner keeps by doing the state
-        # swap and the shadow write in one lock window.
-        import threading
+        self._cv = threading.Condition()
+        self._next_seq = 0      # assigned at submit
+        self._resolve_seq = 0   # B-dispatch order
+        self._collect_seq = 0   # shadow-write order
 
-        self._seq_cv = threading.Condition()
-        self._next_seq = 0
-        self._collect_seq = 0
+    # ---- program A: stateless match + flags ----
 
-    # ---- device program ----
-
-    def _step(self, B: int, L_p: int):
-        key = (B, L_p)
-        hit = self._fns.get(key)
+    def _match_prog(self, Bp: int, L_p: int):
+        key = (Bp, L_p)
+        hit = self._match_fns.get(key)
         if hit is not None:
             return hit
-        pf, wnd = self.pf, self.windows
+        pf = self.pf
         plan = pf.plan
-        block, K, E = pf.capacities(B)
-        core = pf._match_core(B, L_p, K, E, block)
+        block, K, E = pf.capacities(Bp)
+        core = pf._match_core(Bp, L_p, K, E, block)
         n_rules, n_filt = self.n_rules, plan.stage2.n_rules
         n_always = plan.n_always
         f_idx, a_idx = self._f_idx, self._a_idx
         aw, ae = self._aw, self._ae
-        max_events = wnd.max_events
-        limits, iv_s, iv_ns = wnd._limits, wnd._iv_s, wnd._iv_ns
+        max_events = self.windows.max_events
         active_table = self.active_table
-        shifts = jnp.asarray([0, 8, 16, 24], dtype=jnp.int32)
+        shifts = jnp.asarray(_SHIFTS, dtype=jnp.int32)
 
         def unpack_rule_bits(packed):  # [K, nf8] -> [K, n_filt] uint8 0/1
-            b = (packed[:, :, None] >> (7 - jnp.arange(8, dtype=jnp.uint8))) & 1
-            return b.reshape(packed.shape[0], -1)[:, :n_filt]
+            b = (
+                packed.astype(jnp.int32)[:, :, None]
+                >> (7 - jnp.arange(8, dtype=jnp.int32))
+            ) & 1
+            return b.reshape(packed.shape[0], -1)[:, :n_filt].astype(jnp.uint8)
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def step(state, combined, n_real, slots, ts_s, ts_ns, host_idx):
+        @jax.jit
+        def match(combined, n_real, host_idx):
             c = core(combined)
             # dense caller-order bitmap, assembled on device
             m2 = unpack_rule_bits(c["m2p"])                      # [K, n_filt]
-            filt = jnp.zeros((B + 1, n_filt), dtype=jnp.uint8)
-            filt = filt.at[c["idx_caller_k"]].set(m2)[:B]        # row B = dump
-            bits = jnp.zeros((B, n_rules), dtype=jnp.uint8)
+            filt = jnp.zeros((Bp + 1, n_filt), dtype=jnp.uint8)
+            filt = filt.at[c["idx_caller_k"]].set(m2)[:Bp]       # row Bp = dump
+            bits = jnp.zeros((Bp, n_rules), dtype=jnp.uint8)
             bits = bits.at[:, f_idx].set(filt)
+            ab = None
             if n_always:
                 ab = c["ab_caller"] | aw[None, :]
                 empty = (c["lens_raw"] == 0).astype(jnp.uint8)[:, None]
                 ab = ab | (ae[None, :] * empty)
                 bits = bits.at[:, a_idx].set(ab)
-
             # padding rows (row >= n_real) can still carry bits — e.g. an
             # always_match rule's column is all-ones — and MUST NOT reach
-            # the window apply: their pad slot id 0 belongs to a real IP.
-            # Mask the bitmap itself; _apply_core derives its fires from it.
-            real = jax.lax.iota(jnp.int32, B) < n_real
+            # the window apply: their pad slot id belongs to a real IP
+            real = jax.lax.iota(jnp.int32, Bp) < n_real
             bits = bits * real[:, None].astype(jnp.uint8)
+            # the window-event count, computed HERE so every overflow
+            # condition is known before any state is touched
             fire = (bits != 0) & active_table[host_idx]
             n_events = fire.sum(dtype=jnp.int32)
             ok = (
                 (c["n_cand"] <= K) & (c["n_m"] <= E)
                 & (n_events <= max_events)
-            )
-            new_state, ev = W._apply_core(
-                state, bits, active_table, host_idx, slots, ts_s, ts_ns,
-                limits, iv_s, iv_ns,
-                n_rules=n_rules, max_events=max_events, gate=ok,
             )
             flags = jnp.stack([
                 ok.astype(jnp.int32), c["n_cand"], c["n_m"], n_events,
@@ -161,9 +208,42 @@ class FusedWindowsPipeline:
             parts = [
                 ((flags[:, None] >> shifts[None, :]) & 0xFF)
                 .astype(jnp.uint8).reshape(-1),
-                # window events (reference order after host sort by
-                # (line, rule)): int32 lanes for line/rule/hits/ss/sns,
-                # uint8 for mtype/exceeded/seen
+                ((c["idx_caller"][:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1),
+                c["rows"].reshape(-1),
+            ]
+            if n_always:
+                # sparse rows cover only the filterable rules; replay
+                # bookkeeping needs the completed always-rule bits too
+                parts.append(
+                    jnp.packbits(ab.astype(jnp.bool_), axis=1).reshape(-1)
+                )
+            return jnp.concatenate(parts), bits
+
+        self._match_fns[key] = (match, K, E)
+        return match, K, E
+
+    # ---- program B: window apply on a device-resident bitmap ----
+
+    def _apply_prog(self, Bp: int):
+        hit = self._apply_fns.get(Bp)
+        if hit is not None:
+            return hit
+        wnd = self.windows
+        n_rules = self.n_rules
+        max_events = wnd.max_events
+        limits, iv_s, iv_ns = wnd._limits, wnd._iv_s, wnd._iv_ns
+        active_table = self.active_table
+        shifts = jnp.asarray(_SHIFTS, dtype=jnp.int32)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def apply(state, bits, slots, ts_s, ts_ns, host_idx):
+            new_state, ev = W._apply_core(
+                state, bits, active_table, host_idx, slots, ts_s, ts_ns,
+                limits, iv_s, iv_ns,
+                n_rules=n_rules, max_events=max_events,
+            )
+            parts = [
                 ((ev["line"][:, None] >> shifts[None, :]) & 0xFF)
                 .astype(jnp.uint8).reshape(-1),
                 ((ev["rule"][:, None] >> shifts[None, :]) & 0xFF)
@@ -177,158 +257,179 @@ class FusedWindowsPipeline:
                 ev["match_type"].astype(jnp.uint8),
                 ev["exceeded"].astype(jnp.uint8),
                 ev["seen_ip"].astype(jnp.uint8),
-                # sparse matched rows for ConsumeLineResult bookkeeping
-                ((c["idx_caller"][:, None] >> shifts[None, :]) & 0xFF)
-                .astype(jnp.uint8).reshape(-1),
-                c["rows"].reshape(-1),
             ]
-            if n_always:
-                # always-rule bits per line: the sparse rows cover only the
-                # filterable rules, but replay bookkeeping needs e.g. a
-                # catch-all `.*` rule's per-line matches too. Pack the
-                # COMPLETED ab (static always_match/empty_only flags
-                # included), not the raw branch accepts.
-                parts.append(
-                    jnp.packbits(ab.astype(jnp.bool_), axis=1).reshape(-1)
-                )
-            return new_state, jnp.concatenate(parts), bits
+            return new_state, jnp.concatenate(parts)
 
-        self._fns[key] = (step, K, E)
-        return step, K, E
+        self._apply_fns[Bp] = apply
+        return apply
 
-    # ---- host API ----
+    # ---- host API (submit → resolve → collect, each in chunk order) ----
 
     def submit(
         self, cls_ids: np.ndarray, lens: np.ndarray, slots: np.ndarray,
         ts_s: np.ndarray, ts_ns: np.ndarray, host_idx: np.ndarray,
-    ) -> _PendingBatch:
-        """Dispatch one batch (slot pins held by the caller). The window
-        state swap happens here under the windows lock — device-stream
-        order then guarantees a later batch's maintenance (evictions /
-        restores) executes after this batch's apply."""
-        pf, wnd = self.pf, self.windows
+    ) -> _Pend:
+        """Dispatch program A for one chunk (slot pins held by the caller,
+        ownership passes to the pipeline). Any number of chunks may be
+        submitted ahead of their resolves."""
+        pf = self.pf
         cls_ids = np.asarray(cls_ids, dtype=np.int32)
         lens = np.asarray(lens, dtype=np.int32)
         B = cls_ids.shape[0]
         combined, Bp, L_p = pf._assemble(cls_ids, lens)
-        step, K, E = self._step(Bp, L_p)
+        match, K, E = self._match_prog(Bp, L_p)
 
         def pad(a, fill=0):
             a = np.asarray(a)
-            if Bp == B:
+            if Bp == len(a):
                 return a
             return np.concatenate(
-                [a, np.full(Bp - B, fill, dtype=a.dtype)]
+                [a, np.full(Bp - len(a), fill, dtype=a.dtype)]
             )
 
-        with wnd._lock:
-            wnd._run_maintenance_locked()
-            new_state, buf, bits_dev = step(
-                wnd._state, jnp.asarray(combined), jnp.int32(B),
-                jnp.asarray(pad(slots)), jnp.asarray(pad(ts_s)),
-                jnp.asarray(pad(ts_ns)), jnp.asarray(pad(host_idx)),
-            )
-            wnd._state = new_state
+        host_idx_p = pad(host_idx).astype(np.int32)
+        sparse_buf, bits_dev = match(
+            jnp.asarray(combined), jnp.int32(B), jnp.asarray(host_idx_p)
+        )
         try:
-            buf.copy_to_host_async()
+            sparse_buf.copy_to_host_async()
         except AttributeError:
             pass
-        with self._seq_cv:
+        with self._cv:
             seq = self._next_seq
             self._next_seq += 1
-        return _PendingBatch(
-            buf=buf, bits_dev=bits_dev, slots=np.asarray(slots),
-            ts_s=np.asarray(ts_s), ts_ns=np.asarray(ts_ns),
-            host_idx=np.asarray(host_idx), B=B, K=K, E=E, seq=seq,
+        return _Pend(
+            seq=seq, sparse_buf=sparse_buf, bits_dev=bits_dev,
+            slots=np.asarray(slots),
+            ts_s=pad(ts_s).astype(np.int32),
+            ts_ns=pad(ts_ns).astype(np.int32),
+            host_idx=host_idx_p, B=B, Bp=Bp, K=K, E=E,
         )
 
-    def collect(self, p: _PendingBatch) -> "FusedWindowsResult":
-        """Block on a submit()ed batch (collects serialize in submit order
-        so shadow writes land in device-apply order). Overflow taxonomy:
+    def _wait_turn(self, p: _Pend, attr: str) -> None:
+        with self._cv:
+            while getattr(self, attr) != p.seq:
+                self._cv.wait()
 
-        * fused ok — events + sparse matched rows decode from the buffer,
-          the host shadow updates, pins release here.
-        * candidates fit K but rows/events overflowed — the dense device
-          bitmap IS complete; the batch replays through the classic
-          apply_bitmap (splits as needed, releases the pins itself). The
-          sparse rows are valid only when n_m <= E; otherwise the caller
-          reads result.bits (one dense pull, rare path).
-        * candidates overflowed K — stage 2 never saw the excess lines, so
-          even the dense bitmap is incomplete: events is None, bits is
-          None, and the PINS STAY HELD — the caller must recompute the
-          bitmap single-stage and run apply_bitmap with the same slots
-          (which releases them).
-        """
-        # serialize collects in submit order: a later batch's shadow write
-        # landing before an earlier one would leave stale counters that an
-        # eviction could later restore as authoritative
-        with self._seq_cv:
-            while self._collect_seq != p.seq:
-                self._seq_cv.wait()
-        # pin ownership: exactly one release on every path. _collect_inner
-        # moves ownership forward ('released' after its own release,
-        # 'applied' once apply_bitmap — which releases internally — is
-        # entered, 'caller' when returning pins_held=True); an exception
-        # while still 'collect' releases here.
-        owner = ["collect"]
+    def _advance(self, attr: str) -> None:
+        with self._cv:
+            setattr(self, attr, getattr(self, attr) + 1)
+            self._cv.notify_all()
+
+    def resolve(self, p: _Pend) -> None:
+        """Order-gated: decode chunk p's A-flags; when ok, dispatch program
+        B (the window apply) — B dispatches therefore happen strictly in
+        chunk order. Raises PipelineOverflow when the chunk must take the
+        classic fallback; the resolve turn is NOT advanced until the caller
+        completes the fallback (fallback_done), keeping later chunks'
+        applies behind this chunk's."""
+        self._wait_turn(p, "_resolve_seq")
+        if p.state != "submitted":
+            return
         try:
-            return self._collect_inner(p, owner)
-        except Exception:
-            if owner[0] == "collect":
-                self.windows.release_pins(p.slots)
-            raise
-        finally:
-            with self._seq_cv:
-                self._collect_seq += 1
-                self._seq_cv.notify_all()
+            buf = np.asarray(p.sparse_buf)
+            E = p.E
+            flags = np.frombuffer(buf[:16].tobytes(), dtype="<i4")
+            p.flags = flags
+            off = 16
+            idx = np.frombuffer(
+                buf[off : off + 4 * E].tobytes(), dtype="<i4"
+            )
+            off += 4 * E
+            nf8 = self.pf._nf8
+            rows = buf[off : off + E * nf8].reshape(E, nf8)
+            off += E * nf8
+            na8 = self.pf._na8
+            p.always_bits = (
+                buf[off:].reshape(-1, na8)[: p.B] if na8 else None
+            )
+            n_m = int(flags[2])
+            if n_m <= E:
+                live = idx[:n_m]
+                keep = (live >= 0) & (live < p.B)
+                p.matched_rows = live[keep]
+                p.matched_bits = rows[:n_m][keep]
+            if not flags[0]:
+                p.state = "overflow"
+                self.fallback_batches += 1
+                raise PipelineOverflow(
+                    candidate_overflow=int(flags[1]) > p.K
+                )
 
-    def _collect_inner(self, p: _PendingBatch, owner) -> "FusedWindowsResult":
-        wnd = self.windows
-        max_events = wnd.max_events
-        E = p.E
-        buf = np.asarray(p.buf)
-        off = 0
-
-        def take_i32(n):
-            nonlocal off
-            out = np.frombuffer(buf[off : off + 4 * n].tobytes(), dtype="<i4")
-            off += 4 * n
-            return out
-
-        def take_u8(n):
-            nonlocal off
-            out = buf[off : off + n]
-            off += n
-            return out
-
-        flags = take_i32(4)
-        ok = bool(flags[0])
-        n_cand, n_m = int(flags[1]), int(flags[2])
-        ev_line = take_i32(max_events)
-        ev_rule = take_i32(max_events)
-        ev_hits = take_i32(max_events)
-        ev_ss = take_i32(max_events)
-        ev_sns = take_i32(max_events)
-        ev_mtype = take_u8(max_events)
-        ev_exc = take_u8(max_events)
-        ev_seen = take_u8(max_events)
-        midx = take_i32(E)
-        nf8 = self.pf._nf8
-        rows = take_u8(E * nf8).reshape(E, nf8)
-        na8 = self.pf._na8
-        always_bits = (
-            buf[off:].reshape(-1, na8)[: p.B] if na8 else None
-        )
-
-        def sparse():
-            if n_m > E:
-                return None, None
-            live = midx[:n_m]
-            keep = (live >= 0) & (live < p.B)
-            return live[keep], rows[:n_m][keep]
-
-        if ok:
+            wnd = self.windows
+            apply = self._apply_prog(p.Bp)
+            slots_p = p.slots.astype(np.int32)
+            if p.Bp != p.B:
+                slots_p = np.concatenate(
+                    [slots_p, np.zeros(p.Bp - p.B, dtype=np.int32)]
+                )
+            with wnd._lock:
+                wnd._run_maintenance_locked()
+                new_state, ebuf = apply(
+                    wnd._state, p.bits_dev, jnp.asarray(slots_p),
+                    jnp.asarray(p.ts_s), jnp.asarray(p.ts_ns),
+                    jnp.asarray(p.host_idx),
+                )
+                wnd._state = new_state
+            try:
+                ebuf.copy_to_host_async()
+            except AttributeError:
+                pass
+            p.events_buf = ebuf
+            p.state = "resolved"
             self.fused_batches += 1
+        except PipelineOverflow:
+            raise  # turns advance via fallback_done after the fallback
+        except Exception:
+            # the chunk is dead: free BOTH order turns (a stuck turn would
+            # deadlock every later resolve/collect forever) and the pins
+            p.state = "failed"
+            self.windows.release_pins(p.slots)
+            self._advance("_resolve_seq")
+            self._advance("_collect_seq")
+            raise
+        self._advance("_resolve_seq")
+
+    def fallback_done(self, p: _Pend) -> None:
+        """The caller's classic fallback for an overflowing chunk is fully
+        applied (device + shadow + pins released by apply_bitmap): release
+        both order turns."""
+        p.state = "done"
+        self._advance("_resolve_seq")
+        self._advance("_collect_seq")
+
+    def collect(self, p: _Pend) -> FusedWindowsResult:
+        """Order-gated on the collect turn: decode chunk p's window events,
+        absorb the final counter states into the host shadow, release the
+        pins. Only valid for resolved chunks (collect() resolves first on
+        the serial convenience path)."""
+        if p.state == "submitted":
+            self.resolve(p)  # may raise PipelineOverflow to the caller
+        assert p.state == "resolved", p.state
+        self._wait_turn(p, "_collect_seq")
+        wnd = self.windows
+        try:
+            buf = np.asarray(p.events_buf)
+            me = wnd.max_events
+            off = 0
+
+            def take_i32(n):
+                nonlocal off
+                out = np.frombuffer(
+                    buf[off : off + 4 * n].tobytes(), dtype="<i4"
+                )
+                off += 4 * n
+                return out
+
+            ev_line = take_i32(me)
+            ev_rule = take_i32(me)
+            ev_hits = take_i32(me)
+            ev_ss = take_i32(me)
+            ev_sns = take_i32(me)
+            ev_mtype = buf[off : off + me]; off += me
+            ev_exc = buf[off : off + me]; off += me
+            ev_seen = buf[off : off + me]; off += me
+
             live = np.flatnonzero(ev_rule >= 0)
             events = [
                 WindowEvent(
@@ -340,8 +441,9 @@ class FusedWindowsPipeline:
                 )
                 for k in live
             ]
-            # shadow update mirrors _apply_bitmap_inner: key-sorted
-            # event order, last write per (ip, rule) wins
+            # shadow update mirrors _apply_bitmap_inner: key-sorted event
+            # order, last write per (ip, rule) wins; collect order == apply
+            # order, so concurrent chunks can't interleave stale values
             from collections import OrderedDict
 
             with wnd._lock:
@@ -354,46 +456,11 @@ class FusedWindowsPipeline:
                         int(ev_hits[k]), int(ev_ss[k]), int(ev_sns[k])
                     )
             events.sort(key=lambda e: (e.line, e.rule_id))
-            m_rows, m_bits = sparse()
-            owner[0] = "released"
+            p.state = "done"
+            return FusedWindowsResult(
+                events=events, matched_rows=p.matched_rows,
+                matched_bits=p.matched_bits, always_bits=p.always_bits,
+            )
+        finally:
             wnd.release_pins(p.slots)
-            return FusedWindowsResult(
-                events=events, matched_rows=m_rows,
-                matched_bits=m_bits, always_bits=always_bits,
-                bits_dev=p.bits_dev, pins_held=False,
-            )
-
-        self.fallback_batches += 1
-        if n_cand > p.K:
-            # incomplete bitmap: caller recomputes single-stage and runs
-            # apply_bitmap with p.slots (pins stay held until then)
-            owner[0] = "caller"
-            return FusedWindowsResult(
-                events=None, matched_rows=None, matched_bits=None,
-                always_bits=None, bits_dev=None, pins_held=True,
-            )
-        # bitmap complete: classic replay (splits, updates shadow,
-        # releases pins); slice off the padding rows so the row count
-        # matches the unpadded slots/ts vectors
-        owner[0] = "applied"
-        events = wnd.apply_bitmap(
-            p.bits_dev[: p.B], p.slots, p.ts_s, p.ts_ns, self.active_table,
-            p.host_idx,
-        )
-        m_rows, m_bits = sparse()
-        return FusedWindowsResult(
-            events=events, matched_rows=m_rows, matched_bits=m_bits,
-            always_bits=always_bits, bits_dev=p.bits_dev, pins_held=False,
-        )
-
-
-@dataclasses.dataclass
-class FusedWindowsResult:
-    """collect()'s outcome; see its docstring for the overflow taxonomy."""
-
-    events: Optional[List[WindowEvent]]   # None: caller must re-apply
-    matched_rows: Optional[np.ndarray]    # caller rows with >=1 stage2 bit
-    matched_bits: Optional[np.ndarray]    # [len(matched_rows), nf8] packed
-    always_bits: Optional[np.ndarray]     # [B, na8] packed always-rule bits
-    bits_dev: object                      # dense device bitmap (may be None)
-    pins_held: bool                       # True: caller owns the slot pins
+            self._advance("_collect_seq")

@@ -513,36 +513,132 @@ class TpuMatcher(Matcher):
             raise
 
     def _consume_via_pipeline(self, work, cls_ids, lens, results) -> None:
-        """Fully-fused path: match + window apply in one device dispatch.
+        """Two-program fused path (matcher/fused_windows.py): program A
+        (stateless match + overflow flags) dispatches ahead; program B
+        (window apply) dispatches strictly in chunk order once each
+        chunk's flags resolve ok. Up to two chunks overlap: chunk N's
+        device→host pulls hide behind chunk N+1's match compute, and the
+        apply order — hence the reference's log order — is never violated,
+        even across overflow fallbacks (an overflowing chunk drains all
+        earlier chunks first, then replays classically before any later
+        apply dispatches)."""
+        from banjax_tpu.matcher.fused_windows import PipelineOverflow
 
-        Chunks by matcher_batch_lines (one tailer burst must not compile
-        an outsized one-off program), splits like the classic path when
-        slot allocation refuses, and on a candidate-capacity overflow
-        (result.events is None) recomputes the bitmap single-stage and
-        replays through the classic apply — the device state was left
-        untouched by the gate.
+        chunks = [
+            (work[s : s + self._max_batch],
+             cls_ids[s : s + self._max_batch],
+             lens[s : s + self._max_batch])
+            for s in range(0, max(1, len(work)), self._max_batch)
+        ]
+        q: List[dict] = []  # in-flight entries, oldest first
 
-        Chunks apply STRICTLY in order, each fully collected before the
-        next submits. Cross-chunk overlap (submitting N+1 while N's pull
-        is in flight) is deliberately NOT done here: if chunk N takes an
-        overflow fallback, its re-apply would land on the device stream
-        AFTER N+1's already-submitted fused apply — out-of-order window
-        updates, missed bans, and a stale shadow. Overlapping safely needs
-        the match and window-apply programs split so applies dispatch only
-        once the prior chunk's overflow flags are resolved (PERF.md
-        "path to 5M" 3c); the stateless fused matcher path already
-        pipelines freely."""
-        if len(work) > self._max_batch:
-            for s in range(0, len(work), self._max_batch):
-                e = s + self._max_batch
-                self._consume_via_pipeline(
-                    work[s:e], cls_ids[s:e], lens[s:e], results
-                )
-            return
+        def collect_replay(e):
+            res = self._fw_pipeline.collect(e["pend"])
+            sparse = (res.matched_rows, res.matched_bits, res.always_bits)
+            self._replay_window_events(
+                e["work"], None, sparse, res.events, results
+            )
+
+        def resolve_entry(e):
+            """Resolve e (dispatching its B apply); on overflow, drain
+            every earlier chunk first, then replay e classically. Returns
+            False when e was consumed by the fallback."""
+            try:
+                self._fw_pipeline.resolve(e["pend"])
+                return True
+            except PipelineOverflow as ov:
+                drained = False
+                try:
+                    while q and q[0] is not e:
+                        collect_replay(q.pop(0))
+                    drained = True
+                finally:
+                    if not drained:
+                        # the drain itself failed: free e's pins and order
+                        # turns so the error can't become a deadlock
+                        self.device_windows.release_pins(e["slots"])
+                        self._fw_pipeline.fallback_done(e["pend"])
+                        if q and q[0] is e:
+                            q.pop(0)
+                if q and q[0] is e:
+                    q.pop(0)
+                self._pipeline_fallback_entry(e, ov, results)
+                return False
+
+        def drain_all():
+            while q:
+                if q[-1]["pend"].state == "submitted":
+                    if not resolve_entry(q[-1]):
+                        continue
+                head = q.pop(0)
+                if head["pend"].state in ("failed", "done"):
+                    continue  # error/fallback paths already settled it
+                collect_replay(head)
+
+        try:
+            for wc, cc, lc in chunks:
+                entry = self._submit_pipeline_chunk(wc, cc, lc)
+                if entry is None:
+                    # slot allocation refused (more distinct IPs than
+                    # free+unpinned slots): drain in-flight pins, then run
+                    # this chunk through the splitting sync path
+                    drain_all()
+                    self._pipeline_chunk_sync(wc, cc, lc, results)
+                    continue
+                q.append(entry)
+                if len(q) >= 2 and q[-2]["pend"].state == "submitted":
+                    # resolve the previous chunk → its B apply dispatches
+                    # while THIS chunk's match computes
+                    resolve_entry(q[-2])
+                if len(q) >= 3:
+                    collect_replay(q.pop(0))
+            drain_all()
+        except Exception:
+            # failures mid-burst: drain what we can so pins and the
+            # pipeline's order turns are not leaked for in-flight chunks
+            try:
+                drain_all()
+            except Exception:  # noqa: BLE001 — first error wins
+                log.exception("pipeline drain after failure also failed")
+            raise
+
+    def _submit_pipeline_chunk(self, work, cls_ids, lens):
+        """Allocate slots + dispatch program A for one chunk; None when
+        slot allocation refuses. Pins transfer to the pipeline on success."""
+        from banjax_tpu.matcher.windows import split_ns
+
+        dw = self.device_windows
+        slots = dw.slots_for_ips([p.ip for _, p in work])
+        if slots is None:
+            return None
+        try:
+            ts_s, ts_ns = split_ns(
+                np.array([p.timestamp_ns for _, p in work])
+            )
+            host_idx = np.array(
+                [self._host_row.get(p.host, 0) for _, p in work],
+                dtype=np.int32,
+            )
+            pend = self._fw_pipeline.submit(
+                cls_ids, lens, slots, ts_s, ts_ns, host_idx
+            )
+        except Exception:
+            dw.release_pins(slots)
+            raise
+        return {
+            "work": work, "cls": cls_ids, "lens": lens, "slots": slots,
+            "ts_s": ts_s, "ts_ns": ts_ns, "host_idx": host_idx,
+            "pend": pend,
+        }
+
+    def _pipeline_chunk_sync(self, work, cls_ids, lens, results) -> None:
+        """Non-overlapped fallback for a chunk whose slot allocation
+        refused even with nothing in flight: the shared splitting
+        scaffolding recursively halves until allocations fit, running each
+        piece submit→collect serially."""
+        from banjax_tpu.matcher.fused_windows import PipelineOverflow
 
         def make(cls_c, lens_c):
-            """→ (split, apply_fn) over this chunk's encode payload."""
-
             def apply_fn(work_c, slots, ts_s, ts_ns, host_idx, results_c):
                 dw = self.device_windows
                 try:
@@ -552,9 +648,21 @@ class TpuMatcher(Matcher):
                 except Exception:
                     dw.release_pins(slots)
                     raise
-                self._finish_pipeline_chunk(
-                    work_c, cls_c, lens_c, slots, ts_s, ts_ns, host_idx,
-                    pend, results_c,
+                e = {
+                    "work": work_c, "cls": cls_c, "lens": lens_c,
+                    "slots": slots, "ts_s": ts_s, "ts_ns": ts_ns,
+                    "host_idx": host_idx, "pend": pend,
+                }
+                try:
+                    res = self._fw_pipeline.collect(pend)
+                except PipelineOverflow as ov:
+                    self._pipeline_fallback_entry(e, ov, results_c)
+                    return
+                sparse = (
+                    res.matched_rows, res.matched_bits, res.always_bits
+                )
+                self._replay_window_events(
+                    work_c, None, sparse, res.events, results_c
                 )
 
             def split(lo, hi):
@@ -564,39 +672,45 @@ class TpuMatcher(Matcher):
 
         self._with_window_slots(work, *make(cls_ids, lens), results)
 
-    def _finish_pipeline_chunk(
-        self, work, cls_ids, lens, slots, ts_s, ts_ns, host_idx, pend,
-        results,
-    ) -> None:
-        """Collect + replay one submitted pipeline chunk. collect() owns
-        the pins and releases exactly once on every path — including its
-        own exceptions — EXCEPT when it returns pins_held=True (candidate
-        overflow), where ownership transfers here."""
+    def _pipeline_fallback_entry(self, e, ov, results) -> None:
+        """Classic replay of one overflowing chunk (shared by the sync and
+        overlapped paths; caller guarantees all earlier chunks applied)."""
         dw = self.device_windows
-        res = self._fw_pipeline.collect(pend)
-        if res.events is None:
-            # candidate overflow: full-NFA bitmap, classic apply (which
-            # releases the pins the pipeline left held)
-            try:
-                n = len(work)
+        pend = e["pend"]
+        n = len(e["work"])
+        try:
+            if ov.candidate_overflow:
+                # stage 2 never saw the excess lines: recompute full-NFA
                 bits = self._single_stage_bits(
-                    n, cls_ids, lens, np.zeros(n, dtype=bool), np.arange(n)
+                    n, e["cls"], e["lens"], np.zeros(n, dtype=bool),
+                    np.arange(n),
                 )
-            except Exception:
-                dw.release_pins(slots)
-                raise
-            events = dw.apply_bitmap(
-                bits, slots, ts_s, ts_ns, self._active_table, host_idx
+                apply_bits = bits
+            else:
+                # bitmap is complete: keep it DEVICE-resident for the
+                # apply (re-uploading ~16 MB is the transfer this module
+                # exists to avoid); replay uses the sparse rows decoded at
+                # resolve when they fit, else one pull
+                apply_bits = pend.bits_dev[:n]
+                bits = None
+        except Exception:
+            dw.release_pins(e["slots"])
+            self._fw_pipeline.fallback_done(pend)
+            raise
+        try:
+            events = dw.apply_bitmap(  # releases the pins itself
+                apply_bits, e["slots"], e["ts_s"], e["ts_ns"],
+                self._active_table, e["host_idx"],
             )
-            self._replay_window_events(work, bits, None, events, results)
+        finally:
+            self._fw_pipeline.fallback_done(pend)
+        if bits is None and pend.matched_bits is not None:
+            sparse = (pend.matched_rows, pend.matched_bits, pend.always_bits)
+            self._replay_window_events(e["work"], None, sparse, events, results)
             return
-        if res.matched_bits is not None:
-            bits = None
-            sparse = (res.matched_rows, res.matched_bits, res.always_bits)
-        else:
-            bits = np.asarray(res.bits_dev)[: len(work)]
-            sparse = None
-        self._replay_window_events(work, bits, sparse, res.events, results)
+        if bits is None:
+            bits = np.asarray(pend.bits_dev)[:n]
+        self._replay_window_events(e["work"], bits, None, events, results)
 
     def _sparse_row_sets(self, n, sparse):
         """Per-row matched rule-id sets from the pipeline's sparse result."""

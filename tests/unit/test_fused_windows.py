@@ -159,6 +159,43 @@ def test_multi_chunk_with_tight_slot_capacity():
     assert cb.bans == tb.bans
 
 
+def test_mixed_overflow_chunks_keep_apply_order():
+    """The ordering hazard the two-program split exists for: a burst where
+    SOME chunks overflow (classic fallback) and others ride the fused
+    apply, with the same IPs hitting the same rules across chunks. Any
+    out-of-order window application shifts which exact hit trips the
+    limit — the oracle comparison catches one event of reordering."""
+    patterns = bench.generate_rules(25, seed=37)
+    now = time.time()
+    # alternate benign-ish and attack-heavy 64-line stretches so chunk
+    # overflow status flips mid-burst, all on a small shared IP pool
+    lines = []
+    rng_seed = 0
+    for stretch in range(6):
+        rate = 1.0 if stretch % 2 else 0.05
+        rests = bench.generate_lines(64, patterns, seed=40 + stretch,
+                                     attack_rate=rate)
+        for i, r in enumerate(rests):
+            k = len(lines)
+            lines.append(
+                f"{now + k * 0.0004:.6f} 10.11.{k % 6}.1 {r}"
+            )
+    y = _rules_yaml(patterns, hits=4, interval=30)
+    cpu, cb = _mk(CpuMatcher, y)
+    tpu, tb = _mk(
+        TpuMatcher, y, matcher_device_windows=True,
+        matcher_batch_lines=64, matcher_prefilter_cand_frac=0.25,
+    )
+    want = [cpu.consume_line(l, now + 1) for l in lines]
+    got = tpu.consume_lines(lines, now + 1)  # ONE call: 6 chunks overlap
+    assert [_key(a) for a in want] == [_key(b) for b in got]
+    assert cb.bans == tb.bans
+    fw = tpu._fw_pipeline
+    assert fw.fused_batches > 0 and fw.fallback_batches > 0, (
+        fw.fused_batches, fw.fallback_batches,
+    )
+
+
 def test_pipeline_with_eviction_churn():
     """Slot eviction/spill/restore under the pipeline stays lossless."""
     patterns = bench.generate_rules(25, seed=34)
