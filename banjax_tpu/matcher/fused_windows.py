@@ -147,6 +147,10 @@ class FusedWindowsPipeline:
         self._next_seq = 0      # assigned at submit
         self._resolve_seq = 0   # B-dispatch order
         self._collect_seq = 0   # shadow-write order
+        # collect turns of chunks that died in resolve: swept lazily when
+        # the collect counter reaches them (advancing out of turn would
+        # steal an earlier resolved-but-uncollected chunk's turn)
+        self._dead_collect: set = set()
 
     # ---- program A: stateless match + flags ----
 
@@ -313,7 +317,20 @@ class FusedWindowsPipeline:
 
     def _advance(self, attr: str) -> None:
         with self._cv:
-            setattr(self, attr, getattr(self, attr) + 1)
+            v = getattr(self, attr) + 1
+            if attr == "_collect_seq":
+                while v in self._dead_collect:
+                    self._dead_collect.discard(v)
+                    v += 1
+            setattr(self, attr, v)
+            self._cv.notify_all()
+
+    def _mark_collect_dead(self, seq: int) -> None:
+        with self._cv:
+            self._dead_collect.add(seq)
+            while self._collect_seq in self._dead_collect:
+                self._dead_collect.discard(self._collect_seq)
+                self._collect_seq += 1
             self._cv.notify_all()
 
     def resolve(self, p: _Pend) -> None:
@@ -381,12 +398,15 @@ class FusedWindowsPipeline:
         except PipelineOverflow:
             raise  # turns advance via fallback_done after the fallback
         except Exception:
-            # the chunk is dead: free BOTH order turns (a stuck turn would
-            # deadlock every later resolve/collect forever) and the pins
+            # the chunk is dead: free its order turns (a stuck turn would
+            # deadlock every later resolve/collect forever) and the pins.
+            # The resolve turn is held by this call and advances directly;
+            # the collect turn may still belong to an EARLIER uncollected
+            # chunk, so it is marked dead and swept lazily in order.
             p.state = "failed"
             self.windows.release_pins(p.slots)
             self._advance("_resolve_seq")
-            self._advance("_collect_seq")
+            self._mark_collect_dead(p.seq)
             raise
         self._advance("_resolve_seq")
 

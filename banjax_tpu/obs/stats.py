@@ -69,4 +69,8 @@ class MatcherStats:
                 out["MeshFallbackBatches"] = mm.fallback_batches
             if getattr(matcher, "_prefilter", None) is not None:
                 out["PrefilterActive"] = True
+            fw = getattr(matcher, "_fw_pipeline", None)
+            if fw is not None:
+                out["PipelineFusedBatches"] = fw.fused_batches
+                out["PipelineFallbackBatches"] = fw.fallback_batches
         return out

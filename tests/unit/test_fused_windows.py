@@ -170,7 +170,6 @@ def test_mixed_overflow_chunks_keep_apply_order():
     # alternate benign-ish and attack-heavy 64-line stretches so chunk
     # overflow status flips mid-burst, all on a small shared IP pool
     lines = []
-    rng_seed = 0
     for stretch in range(6):
         rate = 1.0 if stretch % 2 else 0.05
         rests = bench.generate_lines(64, patterns, seed=40 + stretch,
