@@ -41,6 +41,7 @@ from banjax_tpu.matcher.encode import classify_bytes, encode_lines
 from banjax_tpu.matcher.kernels import nfa_match
 from banjax_tpu.matcher.rulec import (
     CompiledRules,
+    Pos,
     RuleProgram,
     UnsupportedPattern,
     compile_rule,
@@ -94,6 +95,91 @@ def gate_masks(plan: "PrefilterPlan", prep=None):
     )
 
 
+# Bytes that dominate real log-line traffic (lowercase, digits, and URL /
+# header punctuation). _pos_prob weighs a byte class's hit probability by
+# how much of this set it covers: an exact lowercase byte scores ~1/48, a
+# merged [a-p] class ~16/48 — the units only matter relative to the
+# sel_max budget in _merge_factors.
+_COMMON_BYTES = (
+    bytes(range(0x61, 0x7B)) + bytes(range(0x30, 0x3A)) + b"/.-_ :=?&%"
+)
+_COMMON_MASK = 0
+for _b in _COMMON_BYTES:
+    _COMMON_MASK |= 1 << _b
+
+
+def _pos_prob(cs: int) -> float:
+    """Estimated probability that one benign-traffic byte lands in `cs`.
+
+    The denominator is an *effective alphabet* of ~20, not 256: log-line
+    text is mostly lowercase/digit/URL-punctuation with strongly skewed
+    frequencies, so a k-byte class is hit far more often than k/256. The
+    estimate only has to be conservative enough for the sel_max guard —
+    measured candidate rates (bench's prefilter_gate_fraction) are the
+    ground truth."""
+    common = bin(cs & _COMMON_MASK).count("1")
+    rare = bin(cs).count("1") - common
+    return min(1.0, (common + 0.25 * rare) / 20.0)
+
+
+def _merge_factors(
+    factors: List[Tuple],
+    max_merge: int = 16,
+    sel_max: float = 1e-5,
+) -> List[Tuple]:
+    """Teddy-style factor superimposition: OR byte-similar *equal-length*
+    factors position-wise into one shared automaton (Hyperscan's Teddy
+    buckets several literals into one PSHUFB mask set the same way).
+
+    Soundness: each member's class is a subset of the merged class at
+    every position, so "merged automaton missed" still implies "no member
+    factor present" — the stage-1 gate never drops a true match; merging
+    can only raise the candidate rate, which stage 2 pays for and the
+    differential tests continuously verify end-to-end.
+
+    Only equal-length factors merge. An earlier variant truncated
+    different-length factors to their common prefix; truncation destroys
+    selectivity (a bucket cut to "GET /[a-z]…" fires on most traffic —
+    measured: candidate rate 12.7 % vs the 4.1 % no-merge floor on the
+    bench workload). Equal-length superimposition measured *zero* added
+    candidates on the same workload (4.08 % either way) while shrinking
+    stage-1 words 572 → 37 (15×) — and stage 1 is the scan-bound
+    automaton run on EVERY line (PERF.md: VPU-scan-bound, cost ∝ words),
+    so the fused-path win is near-linear. The `sel_max` budget is the
+    general-workload guard: a bucket stops absorbing factors once its
+    estimated per-start-offset benign hit probability (∏ _pos_prob)
+    exceeds it (wide (?i) case-class merges hit this long before
+    max_merge)."""
+    if max_merge <= 1:
+        return factors
+
+    def sort_key(f):
+        # length first (only equal lengths may merge), then the lowest
+        # member byte per position: lexicographic order clusters
+        # shared-prefix literals ("admin-login"/"admin-setup") together
+        return (len(f),) + tuple((p.cs & -p.cs).bit_length() for p in f)
+
+    out: List[List[int]] = []
+    cur: Optional[List[int]] = None
+    cur_n = 0
+    for f in sorted(factors, key=sort_key):
+        cs_list = [p.cs for p in f]
+        if cur is not None and cur_n < max_merge and len(cs_list) == len(cur):
+            merged = [cur[i] | cs_list[i] for i in range(len(cur))]
+            sel = 1.0
+            for c in merged:
+                sel *= _pos_prob(c)
+            if sel <= sel_max:
+                cur, cur_n = merged, cur_n + 1
+                continue
+        if cur is not None:
+            out.append(cur)
+        cur, cur_n = cs_list, 1
+    if cur is not None:
+        out.append(cur)
+    return [tuple(Pos(c) for c in cs) for cs in out]
+
+
 def build_plan(
     patterns: Sequence[str],
     min_factor_len: int = 3,
@@ -101,6 +187,8 @@ def build_plan(
     min_filterable_fraction: float = 0.5,
     byte_classes=None,
     stage2_shards="auto",
+    factor_merge: int = 16,
+    factor_sel_max: float = 1e-5,
 ) -> Optional[PrefilterPlan]:
     """Split `patterns` into the two-stage plan, or None when the ruleset
     doesn't profit (too few filterable rules — the two-pass overhead would
@@ -120,8 +208,7 @@ def build_plan(
             programs.append(None)
             unsupported[i] = str(e)
 
-    factor_key_to_col: Dict[Tuple, int] = {}
-    factor_progs: List[RuleProgram] = []
+    distinct_factors: Dict[Tuple, Tuple] = {}
     always_ids: List[int] = []
     filt_ids: List[int] = []
     for i, prog in enumerate(programs):
@@ -135,10 +222,13 @@ def build_plan(
             continue
         filt_ids.append(i)
         for f in factors:
-            key = tuple(p.cs for p in f)
-            if key not in factor_key_to_col:
-                factor_key_to_col[key] = len(factor_progs)
-                factor_progs.append(factor_program(f))
+            distinct_factors.setdefault(tuple(p.cs for p in f), f)
+    merged = _merge_factors(
+        list(distinct_factors.values()),
+        max_merge=factor_merge,
+        sel_max=factor_sel_max,
+    )
+    factor_progs = [factor_program(f) for f in merged]
 
     n_device = len(always_ids) + len(filt_ids)
     if (
@@ -162,10 +252,11 @@ def build_plan(
         stage2_programs, n_shards=stage2_shards, byte_classes=byte_classes
     )
     log.info(
-        "prefilter plan: %d always + %d filterable rules, %d distinct factors; "
-        "stage1 %d words, stage2 %d words",
-        len(always_ids), len(filt_ids), len(factor_progs),
-        s1.n_words, s2.n_words,
+        "prefilter plan: %d always + %d filterable rules, %d distinct "
+        "factors in %d superimposed buckets; stage1 %d words, stage2 %d "
+        "words",
+        len(always_ids), len(filt_ids), len(distinct_factors),
+        len(factor_progs), s1.n_words, s2.n_words,
     )
     return PrefilterPlan(
         n_rules=len(patterns),
@@ -287,7 +378,7 @@ class _Pending:
     buf: object          # device array, copy_to_host_async already started
     B: int               # caller rows
     K: int               # candidate capacity
-    E: int               # matched-row output capacity
+    P: int               # (row, rule) pair output capacity
     lens: np.ndarray     # caller-order lens (for empty_only always-rules)
 
 
@@ -305,12 +396,12 @@ class FusedPrefilter:
     `byte_classes` of the caller's full ruleset so the caller's encode (or
     native fastparse output) is consumed verbatim.
 
-    Capacity: K = max(block, ceil(B * cand_frac)) compacted lines. The
-    candidate count is returned with the bitmap; `n_cand > K` raises
-    PrefilterOverflow (soundness: a truncated candidate set would silently
-    under-match) and the caller reruns that batch single-stage — an
-    adversarial all-matching stream degrades to the single-stage rate, never
-    to wrong output.
+    Capacity: K = max(block, ceil(B * cand_frac)) compacted lines, and
+    P = ceil(B * out_frac) output (row, rule) pairs. Both counts come back
+    with the result; exceeding either raises PrefilterOverflow (soundness:
+    a truncated candidate or pair set would silently under-match) and the
+    caller reruns that batch single-stage — an adversarial all-matching
+    stream degrades to the single-stage rate, never to wrong output.
     """
 
     def __init__(self, plan: PrefilterPlan, backend: str,
@@ -455,11 +546,22 @@ class FusedPrefilter:
         return combined, Bp, L_p
 
     def capacities(self, B: int):
-        """(block, K candidate slots, E matched-row slots) for a batch."""
+        """(block, K candidate slots, E matched-row slots) for a batch.
+
+        E sizes the matched-row compaction used by the fused
+        matcher+windows pipeline (fused_windows.py); the plain
+        submit/collect path ships (row, rule) pairs instead — see
+        pair_capacity."""
         block = self._block_for(B)
         K = min(B, max(block, -(-int(B * self.cand_frac) // block) * block))
         E = min(K, max(64, int(K * self.out_frac)))
         return block, K, E
+
+    def pair_capacity(self, B: int, K: int) -> int:
+        """Output slots for the sparse (row, rule) pair encoding: one int32
+        per set rule bit, budgeted at `out_frac` pairs per caller line and
+        capped by the true maximum (every candidate matching every rule)."""
+        return min(max(128, int(B * self.out_frac)), K * self.plan.stage2.n_rules)
 
     def _match_core(self, B: int, L_p: int, K: int, E: int, block: int):
         """The traceable two-stage match body, shared by the sparse-output
@@ -540,29 +642,49 @@ class FusedPrefilter:
         core = self._match_core(B, L_p, K, E, block)
         n_always = self.plan.n_always
         shifts = jnp.asarray([0, 8, 16, 24], dtype=jnp.int32)
+        P = self.pair_capacity(B, K)
+        R8 = self._nf8 * 8
+        if B * R8 >= 2**31:
+            raise ValueError(
+                f"batch {B} x {R8} packed rule columns overflows the int32 "
+                "(row, rule) pair encoding — lower matcher_batch_lines"
+            )
 
         @jax.jit
         def fused(cls_and_lens):
             """One int32 input transfer (the tunnel charges fixed latency
             per transfer, and int32 2-D is its fast path — see
             _match_core for the input layout) → one uint8 buffer:
-              n_cand[4] ‖ n_matched[4] ‖ matched caller-row idx[4E] ‖
-              matched packed rule rows [E * nf8] ‖ always-rule bits [B * na8].
-            A single buffer = a single device→host pull — the tunnel charges
-            ~65 ms of fixed latency per pull regardless of size, so the
-            sparse result must come back in one piece (and overlapped, see
-            submit/collect). Two compaction levels: stage 1's factor gate
-            selects K candidate lines for stage 2, and only candidates that
-            actually MATCHED a rule (typically a few %) are shipped back.
-            Length-sort, transpose, unpack, and the sorted→caller index
-            mapping all happen on device: the host does no O(B·L) work."""
+              n_cand[4] ‖ n_pairs[4] ‖ (row, rule) pairs [4P] ‖
+              always-rule bits [B * na8].
+            A single buffer = a single device→host pull, and a SMALL one:
+            each set rule bit ships as one int32 (caller_row * R8 + packed
+            bit column) instead of a full ceil(R/8)-byte row bitmap per
+            matched line. At the tunnel's ~20-25 MB/s d2h the old row
+            encoding (E = B/4 rows x 125 B at 1k rules) cost ~80 ms per
+            64k batch — more than the kernels; pairs are ~30x smaller, so
+            the pull is pure fixed latency (~65 ms) and pipelines away
+            behind compute (see submit/collect). Stage-1's factor gate
+            still bounds stage-2 work to K candidate lines; the E-row
+            compaction in _match_core is left for XLA to dead-code
+            eliminate (fused_windows still consumes it)."""
             c = core(cls_and_lens)
+            m2p = c["m2p"]                                       # [K, nf8]
+            bits = (
+                (m2p[:, :, None] >> (7 - jnp.arange(8, dtype=jnp.int32))) & 1
+            ).reshape(K, R8)                                     # MSB-first
+            n_pairs = jnp.sum(bits, dtype=jnp.int32)
+            (flat,) = jnp.nonzero(bits.reshape(-1), size=P, fill_value=0)
+            k = flat // R8
+            col = flat - k * R8
+            caller = jnp.take(c["idx_caller_k"], k)              # [P]
+            live = jax.lax.iota(jnp.int32, P) < n_pairs
+            pairs = jnp.where(live, caller * R8 + col, -1)
             parts = [
                 ((c["n_cand"][None] >> shifts) & 0xFF).astype(jnp.uint8),
-                ((c["n_m"][None] >> shifts) & 0xFF).astype(jnp.uint8),
-                ((c["idx_caller"][:, None] >> shifts[None, :]) & 0xFF)
+                ((n_pairs[None] >> shifts) & 0xFF).astype(jnp.uint8),
+                ((pairs[:, None] >> shifts[None, :]) & 0xFF)
                 .astype(jnp.uint8).reshape(-1),
-                c["rows"].reshape(-1),
             ]
             if n_always:
                 parts.append(
@@ -572,8 +694,8 @@ class FusedPrefilter:
                 )
             return jnp.concatenate(parts)
 
-        self._fns[key] = (fused, K, E)
-        return fused, K, E
+        self._fns[key] = (fused, K, P)
+        return fused, K, P
 
     # ---- host API ----
 
@@ -590,13 +712,13 @@ class FusedPrefilter:
         lens = np.asarray(lens, dtype=np.int32)
         B = cls_ids.shape[0]
         combined, Bp, L_p = self._assemble(cls_ids, lens)
-        fn, K, E = self._fused(Bp, L_p)
+        fn, K, P = self._fused(Bp, L_p)
         buf = fn(jnp.asarray(combined))
         try:
             buf.copy_to_host_async()
         except AttributeError:  # interpret/CPU arrays may lack the method
             pass
-        return _Pending(buf=buf, B=B, K=K, E=E, lens=lens)
+        return _Pending(buf=buf, B=B, K=K, P=P, lens=lens)
 
     def collect(self, p: _Pending) -> np.ndarray:
         """Block on a submit()ed batch → [B, n_rules] uint8 bits in caller
@@ -604,26 +726,30 @@ class FusedPrefilter:
         was exceeded (the caller reruns the batch single-stage)."""
         plan = self.plan
         buf = np.asarray(p.buf)
-        K, E, B = p.K, p.E, p.B
+        K, P, B = p.K, p.P, p.B
+        R8 = self._nf8 * 8
         head = np.frombuffer(buf[:8].tobytes(), dtype="<i4")
-        n_cand, n_m = int(head[0]), int(head[1])
+        n_cand, n_pairs = int(head[0]), int(head[1])
+        # observability: the stage-1 gate rate (≥ the true match rate; the
+        # gap is the superimposition + factor false-positive cost that
+        # stage 2 pays for). bench reports it as prefilter_gate_fraction.
+        self.last_n_cand = n_cand
         if n_cand > K:
             raise PrefilterOverflow(f"{n_cand} candidates > capacity {K}")
-        if n_m > E:
-            raise PrefilterOverflow(f"{n_m} matched rows > capacity {E}")
-        idx = np.frombuffer(buf[8 : 8 + 4 * E].tobytes(), dtype="<i4")
-        off = 8 + 4 * E
-        rows = buf[off : off + E * self._nf8].reshape(E, self._nf8)
+        if n_pairs > P:
+            raise PrefilterOverflow(f"{n_pairs} match pairs > capacity {P}")
+        pairs = np.frombuffer(buf[8 : 8 + 4 * P].tobytes(), dtype="<i4")
         bits = np.zeros((B, plan.n_rules), dtype=np.uint8)
-        if n_m:
-            live = idx[:n_m]
-            keep = (live >= 0) & (live < B)
-            filt = np.unpackbits(
-                rows[:n_m][keep], axis=1, count=plan.stage2.n_rules
+        if n_pairs:
+            live = pairs[:n_pairs]
+            rows_idx, cols = live // R8, live % R8
+            keep = (
+                (rows_idx >= 0) & (rows_idx < B)
+                & (cols < plan.stage2.n_rules)
             )
-            bits[np.ix_(live[keep], plan.f_idx)] = filt
+            bits[rows_idx[keep], plan.f_idx[cols[keep]]] = 1
         if plan.n_always:
-            off += E * self._nf8
+            off = 8 + 4 * P
             ap = buf[off:].reshape(-1, self._na8)[:B]  # caller-order rows
             abits = np.unpackbits(ap, axis=1, count=plan.n_always)
             abits[:, self._a_always] = 1

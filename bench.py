@@ -292,6 +292,10 @@ def _bench_fused(jax, patterns, compiled, backend, batch, out):
     out["prefilter_candidate_fraction"] = round(
         float(want.any(axis=1).mean()), 4
     )
+    if getattr(fp, "last_n_cand", None) is not None:
+        # stage-1 gate rate: what fraction of lines actually reached
+        # stage 2 (true matches + factor/superimposition false positives)
+        out["prefilter_gate_fraction"] = round(fp.last_n_cand / batch, 4)
 
     for _ in range(2):  # warm
         fp.collect(fp.submit(cls_ids, lens))

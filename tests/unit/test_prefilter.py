@@ -370,3 +370,95 @@ class TestFusedPrefilter:
             assert [r.rule_name for r in a.rule_results] == [
                 r.rule_name for r in b.rule_results
             ]
+
+
+class TestFactorMerging:
+    """Teddy-style equal-length superimposition (prefilter._merge_factors)."""
+
+    def _factors(self, pats):
+        distinct = {}
+        for pat in pats:
+            fs = required_factors(compile_rule(pat))
+            assert fs is not None, pat
+            for f in fs:
+                distinct.setdefault(tuple(p.cs for p in f), f)
+        return list(distinct.values())
+
+    def test_members_subset_of_bucket(self):
+        """Every original factor maps into some bucket position-wise:
+        same length, member class ⊆ merged class — the soundness
+        precondition ("bucket missed ⟹ member absent")."""
+        from banjax_tpu.matcher.prefilter import _merge_factors
+
+        pats = [rf"GET /admin-{w}/x\.php" for w in
+                ("alpha", "bravo", "civic", "delta", "eagle")]
+        factors = _merge_factors(self._factors(pats), max_merge=8)
+        originals = self._factors(pats)
+        for f in originals:
+            assert any(
+                len(m) == len(f)
+                and all(f[i].cs & ~m[i].cs == 0 for i in range(len(f)))
+                for m in factors
+            ), f
+        # the five same-shape factors actually share buckets
+        assert len(factors) < len(originals)
+
+    def test_unequal_lengths_never_merge(self):
+        from banjax_tpu.matcher.prefilter import _merge_factors
+
+        factors = self._factors([r"abcdef", r"abcdefgh"])
+        merged = _merge_factors(factors, max_merge=8)
+        assert sorted(len(m) for m in merged) == sorted(
+            len(f) for f in factors
+        )
+
+    def test_sel_budget_stops_wide_merges(self):
+        """(?i) case-pair factors OR into wide classes; the sel guard must
+        stop the bucket before it covers most of the alphabet."""
+        from banjax_tpu.matcher.prefilter import _merge_factors, _pos_prob
+
+        pats = [rf"(?i){a}{b}{c}scan" for a in "abcdef" for b in "klmnop"
+                for c in "uvwxyz"]
+        merged = _merge_factors(self._factors(pats), max_merge=64,
+                                sel_max=1e-5)
+        for m in merged:
+            sel = 1.0
+            for p in m:
+                sel *= _pos_prob(p.cs)
+            assert sel <= 1e-5
+
+    def test_merge_disabled_is_identity(self):
+        from banjax_tpu.matcher.prefilter import _merge_factors
+
+        factors = self._factors([r"abcdef", r"uvwxyz"])
+        assert _merge_factors(factors, max_merge=1) == factors
+
+    def test_merged_plan_bitmap_still_exact(self):
+        """End-to-end: an aggressively merged plan still produces the
+        single-stage bitmap bit for bit (stage 2 pays for every stage-1
+        false positive)."""
+        patterns = (
+            [rf"GET /admin-{w}/[a-z]+\.php" for w in
+             ("alpha", "bravo", "civic", "delta")]
+            + [rf"POST /login{d}[0-9]{{2}}" for d in range(4)]
+            + [r"(?i)sqlmap|nikto"]
+        )
+        plan = build_plan(patterns, min_filterable_fraction=0.4,
+                          factor_merge=64, factor_sel_max=1e-3)
+        assert plan is not None
+        import bench as _bench
+
+        lines = _bench.generate_lines(512, patterns, seed=3,
+                                      attack_rate=0.3)
+        pf = PrefilterMatcher(plan, "xla", max_len=128, max_batch=256)
+        bits, host_eval = pf.match_bits(lines)
+        assert not host_eval.any()
+        compiled = compile_rules(patterns)
+        params = nfa_jax.match_params(compiled)
+        cls_ids, lens, _ = encode_for_match(compiled, lines, 128)
+        want = np.asarray(
+            nfa_jax.match_batch(params, cls_ids, lens, compiled.n_rules)
+        )
+        for rid in plan.unsupported:
+            want[:, rid] = 0
+        assert (bits == want).all()
