@@ -14,11 +14,11 @@ already-submitted chunk N+1, reordering window updates. Splitting fixes it:
 
   program A — MATCH (stateless): two-stage match (prefilter._match_core),
     dense caller-order bitmap assembly, and ALL overflow flags — candidate
-    count, matched-row count, and the window-event count (it takes
+    count, match-pair count, and the window-event count (it takes
     host_idx + active_table precisely so the event count is known before
     any state is touched). Outputs: one sparse host buffer (flags ‖
-    matched rows ‖ always-rule bits) and the device-resident bitmap.
-    A dispatches freely, any number of chunks ahead.
+    (row, rule) match pairs ‖ always-rule bits) and the device-resident
+    bitmap. A dispatches freely, any number of chunks ahead.
 
   program B — APPLY (window state donated): the window segmented scan
     (windows._apply_core) over A's bitmap. B for chunk i is dispatched
@@ -79,13 +79,12 @@ class _Pend:
     B: int                 # real rows
     Bp: int
     K: int
-    E: int
+    P: int
     state: str = "submitted"
     flags: Optional[np.ndarray] = None     # [4] after resolve
     events_buf: object = None              # program B's buffer
     # decoded at resolve (from the A pull)
-    matched_rows: Optional[np.ndarray] = None
-    matched_bits: Optional[np.ndarray] = None
+    matched_pairs: Optional[np.ndarray] = None
     always_bits: Optional[np.ndarray] = None
 
 
@@ -94,8 +93,7 @@ class FusedWindowsResult:
     """Outcome of one collected chunk."""
 
     events: List[WindowEvent]
-    matched_rows: Optional[np.ndarray]    # caller rows with >=1 stage-2 bit
-    matched_bits: Optional[np.ndarray]    # [len(matched_rows), nf8] packed
+    matched_pairs: Optional[np.ndarray]   # int32 caller_row * R8 + bit col
     always_bits: Optional[np.ndarray]     # [B, na8] packed always-rule bits
 
 
@@ -106,7 +104,7 @@ class PipelineOverflow(RuntimeError):
     def __init__(self, candidate_overflow: bool):
         super().__init__(
             "candidate capacity exceeded" if candidate_overflow
-            else "matched-row/event capacity exceeded"
+            else "match-pair/event capacity exceeded"
         )
         # True: stage 2 never saw the excess lines — even the dense bitmap
         # is incomplete and must be recomputed single-stage
@@ -161,8 +159,9 @@ class FusedWindowsPipeline:
             return hit
         pf = self.pf
         plan = pf.plan
-        block, K, E = pf.capacities(Bp)
-        core = pf._match_core(Bp, L_p, K, E, block)
+        block, K = pf.capacities(Bp)
+        core = pf._match_core(Bp, L_p, K, block)
+        P = pf.pair_capacity(Bp, K)
         n_rules, n_filt = self.n_rules, plan.stage2.n_rules
         n_always = plan.n_always
         f_idx, a_idx = self._f_idx, self._a_idx
@@ -171,18 +170,18 @@ class FusedWindowsPipeline:
         active_table = self.active_table
         shifts = jnp.asarray(_SHIFTS, dtype=jnp.int32)
 
-        def unpack_rule_bits(packed):  # [K, nf8] -> [K, n_filt] uint8 0/1
-            b = (
-                packed.astype(jnp.int32)[:, :, None]
-                >> (7 - jnp.arange(8, dtype=jnp.int32))
-            ) & 1
-            return b.reshape(packed.shape[0], -1)[:, :n_filt].astype(jnp.uint8)
-
         @jax.jit
         def match(combined, n_real, host_idx):
             c = core(combined)
+            # sparse (row, rule) pair output — the shared encoding
+            # (prefilter.pairs_from_core): one int32 per set stage-2 bit
+            # instead of a packed row bitmap per matched line (~30x less
+            # d2h on the tunnel, whose ~20-25 MB/s would otherwise
+            # dominate the chunk budget). pair_bits doubles as the dense
+            # per-candidate form for the bitmap assembly below.
+            pairs, n_pairs, pair_bits = pf.pairs_from_core(c, K, P)
             # dense caller-order bitmap, assembled on device
-            m2 = unpack_rule_bits(c["m2p"])                      # [K, n_filt]
+            m2 = pair_bits[:, :n_filt].astype(jnp.uint8)         # [K, n_filt]
             filt = jnp.zeros((Bp + 1, n_filt), dtype=jnp.uint8)
             filt = filt.at[c["idx_caller_k"]].set(m2)[:Bp]       # row Bp = dump
             bits = jnp.zeros((Bp, n_rules), dtype=jnp.uint8)
@@ -203,18 +202,17 @@ class FusedWindowsPipeline:
             fire = (bits != 0) & active_table[host_idx]
             n_events = fire.sum(dtype=jnp.int32)
             ok = (
-                (c["n_cand"] <= K) & (c["n_m"] <= E)
+                (c["n_cand"] <= K) & (n_pairs <= P)
                 & (n_events <= max_events)
             )
             flags = jnp.stack([
-                ok.astype(jnp.int32), c["n_cand"], c["n_m"], n_events,
+                ok.astype(jnp.int32), c["n_cand"], n_pairs, n_events,
             ])
             parts = [
                 ((flags[:, None] >> shifts[None, :]) & 0xFF)
                 .astype(jnp.uint8).reshape(-1),
-                ((c["idx_caller"][:, None] >> shifts[None, :]) & 0xFF)
+                ((pairs[:, None] >> shifts[None, :]) & 0xFF)
                 .astype(jnp.uint8).reshape(-1),
-                c["rows"].reshape(-1),
             ]
             if n_always:
                 # sparse rows cover only the filterable rules; replay
@@ -224,8 +222,8 @@ class FusedWindowsPipeline:
                 )
             return jnp.concatenate(parts), bits
 
-        self._match_fns[key] = (match, K, E)
-        return match, K, E
+        self._match_fns[key] = (match, K, P)
+        return match, K, P
 
     # ---- program B: window apply on a device-resident bitmap ----
 
@@ -281,7 +279,7 @@ class FusedWindowsPipeline:
         lens = np.asarray(lens, dtype=np.int32)
         B = cls_ids.shape[0]
         combined, Bp, L_p = pf._assemble(cls_ids, lens)
-        match, K, E = self._match_prog(Bp, L_p)
+        match, K, P = self._match_prog(Bp, L_p)
 
         def pad(a, fill=0):
             a = np.asarray(a)
@@ -307,7 +305,7 @@ class FusedWindowsPipeline:
             slots=np.asarray(slots),
             ts_s=pad(ts_s).astype(np.int32),
             ts_ns=pad(ts_ns).astype(np.int32),
-            host_idx=host_idx_p, B=B, Bp=Bp, K=K, E=E,
+            host_idx=host_idx_p, B=B, Bp=Bp, K=K, P=P,
         )
 
     def _wait_turn(self, p: _Pend, attr: str) -> None:
@@ -345,27 +343,25 @@ class FusedWindowsPipeline:
             return
         try:
             buf = np.asarray(p.sparse_buf)
-            E = p.E
+            P = p.P
+            R8 = self.pf._nf8 * 8
             flags = np.frombuffer(buf[:16].tobytes(), dtype="<i4")
             p.flags = flags
             off = 16
-            idx = np.frombuffer(
-                buf[off : off + 4 * E].tobytes(), dtype="<i4"
+            pairs = np.frombuffer(
+                buf[off : off + 4 * P].tobytes(), dtype="<i4"
             )
-            off += 4 * E
-            nf8 = self.pf._nf8
-            rows = buf[off : off + E * nf8].reshape(E, nf8)
-            off += E * nf8
+            off += 4 * P
             na8 = self.pf._na8
             p.always_bits = (
                 buf[off:].reshape(-1, na8)[: p.B] if na8 else None
             )
-            n_m = int(flags[2])
-            if n_m <= E:
-                live = idx[:n_m]
-                keep = (live >= 0) & (live < p.B)
-                p.matched_rows = live[keep]
-                p.matched_bits = rows[:n_m][keep]
+            n_pairs = int(flags[2])
+            if n_pairs <= P:
+                live = pairs[:n_pairs]
+                rows_idx = live // R8
+                keep = (rows_idx >= 0) & (rows_idx < p.B)
+                p.matched_pairs = live[keep]
             if not flags[0]:
                 p.state = "overflow"
                 self.fallback_batches += 1
@@ -478,8 +474,8 @@ class FusedWindowsPipeline:
             events.sort(key=lambda e: (e.line, e.rule_id))
             p.state = "done"
             return FusedWindowsResult(
-                events=events, matched_rows=p.matched_rows,
-                matched_bits=p.matched_bits, always_bits=p.always_bits,
+                events=events, matched_pairs=p.matched_pairs,
+                always_bits=p.always_bits,
             )
         finally:
             wnd.release_pins(p.slots)

@@ -546,32 +546,53 @@ class FusedPrefilter:
         return combined, Bp, L_p
 
     def capacities(self, B: int):
-        """(block, K candidate slots, E matched-row slots) for a batch.
-
-        E sizes the matched-row compaction used by the fused
-        matcher+windows pipeline (fused_windows.py); the plain
-        submit/collect path ships (row, rule) pairs instead — see
-        pair_capacity."""
+        """(block, K candidate slots) for a batch."""
         block = self._block_for(B)
         K = min(B, max(block, -(-int(B * self.cand_frac) // block) * block))
-        E = min(K, max(64, int(K * self.out_frac)))
-        return block, K, E
+        return block, K
 
     def pair_capacity(self, B: int, K: int) -> int:
         """Output slots for the sparse (row, rule) pair encoding: one int32
         per set rule bit, budgeted at `out_frac` pairs per caller line and
         capped by the true maximum (every candidate matching every rule)."""
+        if B * self._nf8 * 8 >= 2**31:
+            raise ValueError(
+                f"batch {B} x {self._nf8 * 8} packed rule columns overflows "
+                "the int32 (row, rule) pair encoding — lower "
+                "matcher_batch_lines"
+            )
         return min(max(128, int(B * self.out_frac)), K * self.plan.stage2.n_rules)
 
-    def _match_core(self, B: int, L_p: int, K: int, E: int, block: int):
+    def pairs_from_core(self, c, K: int, P: int):
+        """The sparse (row, rule) pair extraction shared by the plain fused
+        program and the fused-windows program A: one int32 per set stage-2
+        bit, encoded caller_row * R8 + packed bit column (R8 = 8 * nf8),
+        -1 beyond n_pairs. Returns (pairs [P] int32, n_pairs, bits [K, R8])
+        — `bits` is the unpacked MSB-first bit tensor so callers needing
+        the per-candidate dense form don't unpack m2p twice."""
+        R8 = self._nf8 * 8
+        bits = (
+            (c["m2p"][:, :, None] >> (7 - jnp.arange(8, dtype=jnp.int32))) & 1
+        ).reshape(K, R8)
+        n_pairs = jnp.sum(bits, dtype=jnp.int32)
+        (flat,) = jnp.nonzero(bits.reshape(-1), size=P, fill_value=0)
+        k = flat // R8
+        col = flat - k * R8
+        caller = jnp.take(c["idx_caller_k"], k)
+        live = jax.lax.iota(jnp.int32, P) < n_pairs
+        pairs = jnp.where(live, caller * R8 + col, -1)
+        return pairs, n_pairs, bits
+
+    def _match_core(self, B: int, L_p: int, K: int, block: int):
         """The traceable two-stage match body, shared by the sparse-output
         fused program and the fused matcher+windows pipeline
         (matcher/fused_windows.py). Input: [B, 1 + L4|L_p] int32 combined
         array (column 0 = lens; class row packed 4 uint8 ids per int32 when
         the partition fits a byte — see submit()). Returns every
-        intermediate a consumer needs: the candidate compaction, stage-2
-        packed rows, the second (matched-row) compaction, and the
-        always-rule bits in caller row order."""
+        intermediate a consumer needs: the candidate count, the stage-2
+        packed rows with their caller-row mapping (feed pairs_from_core
+        for the sparse output), and the always-rule bits in caller row
+        order."""
         plan = self.plan
         f1 = self._stage1_raw(B, L_p, block)
         f2 = self._stage2(K, L_p, min(block, K))
@@ -602,22 +623,11 @@ class FusedPrefilter:
             cls2_t = jnp.take(cls_t, idx, axis=1)                # [L_p, K]
             lens2 = jnp.where(valid, jnp.take(lens, idx), 0)
             m2p = f2(cls2_t, lens2) & (valid[:, None] * jnp.uint8(0xFF))
-            # second compaction: only candidate rows with at least one rule
-            # bit set go home
-            hit = m2p.max(axis=1) > 0                            # [K]
-            n_m = jnp.sum(hit.astype(jnp.int32))
-            (midx,) = jnp.nonzero(hit, size=E, fill_value=0)     # [E]
-            mvalid = jax.lax.iota(jnp.int32, E) < n_m
-            rows = jnp.take(m2p, midx, axis=0) & (
-                mvalid[:, None] * jnp.uint8(0xFF)
-            )
-            # caller rows for ALL candidate slots (K-domain, B = invalid)
+            # caller rows for ALL candidate slots (K-domain, B = invalid;
+            # invalid slots carry no m2p bits, so they can never surface
+            # through the (row, rule) pair extraction)
             idx_caller_k = jnp.where(
                 valid, jnp.take(order, idx), jnp.int32(B)
-            )
-            # ...and for the matched-row compaction (E-domain, -1 = invalid)
-            idx_caller = jnp.where(
-                mvalid, jnp.take(idx_caller_k, midx), -1
             )
             ab_caller = None
             if n_always:
@@ -626,8 +636,7 @@ class FusedPrefilter:
                 ab = ab.at[a_rule].max(sel.astype(jnp.uint8))
                 ab_caller = jnp.zeros_like(ab.T).at[order].set(ab.T)
             return {
-                "lens_raw": lens_raw, "n_cand": n_cand, "n_m": n_m,
-                "m2p": m2p, "rows": rows, "idx_caller": idx_caller,
+                "lens_raw": lens_raw, "n_cand": n_cand, "m2p": m2p,
                 "idx_caller_k": idx_caller_k, "ab_caller": ab_caller,
             }
 
@@ -638,17 +647,11 @@ class FusedPrefilter:
         hit = self._fns.get(key)
         if hit is not None:
             return hit
-        block, K, E = self.capacities(B)
-        core = self._match_core(B, L_p, K, E, block)
+        block, K = self.capacities(B)
+        core = self._match_core(B, L_p, K, block)
         n_always = self.plan.n_always
         shifts = jnp.asarray([0, 8, 16, 24], dtype=jnp.int32)
         P = self.pair_capacity(B, K)
-        R8 = self._nf8 * 8
-        if B * R8 >= 2**31:
-            raise ValueError(
-                f"batch {B} x {R8} packed rule columns overflows the int32 "
-                "(row, rule) pair encoding — lower matcher_batch_lines"
-            )
 
         @jax.jit
         def fused(cls_and_lens):
@@ -658,28 +661,16 @@ class FusedPrefilter:
               n_cand[4] ‖ n_pairs[4] ‖ (row, rule) pairs [4P] ‖
               always-rule bits [B * na8].
             A single buffer = a single device→host pull, and a SMALL one:
-            each set rule bit ships as one int32 (caller_row * R8 + packed
-            bit column) instead of a full ceil(R/8)-byte row bitmap per
-            matched line. At the tunnel's ~20-25 MB/s d2h the old row
-            encoding (E = B/4 rows x 125 B at 1k rules) cost ~80 ms per
-            64k batch — more than the kernels; pairs are ~30x smaller, so
-            the pull is pure fixed latency (~65 ms) and pipelines away
-            behind compute (see submit/collect). Stage-1's factor gate
-            still bounds stage-2 work to K candidate lines; the E-row
-            compaction in _match_core is left for XLA to dead-code
-            eliminate (fused_windows still consumes it)."""
+            each set rule bit ships as one int32 (pairs_from_core) instead
+            of a full ceil(R/8)-byte row bitmap per matched line. At the
+            tunnel's ~20-25 MB/s d2h the old row encoding (B/4 rows x
+            125 B at 1k rules) cost ~80 ms per 64k batch — more than the
+            kernels; pairs are ~30x smaller, so the pull is pure fixed
+            latency (~65 ms) and pipelines away behind compute (see
+            submit/collect). Stage-1's factor gate still bounds stage-2
+            work to K candidate lines."""
             c = core(cls_and_lens)
-            m2p = c["m2p"]                                       # [K, nf8]
-            bits = (
-                (m2p[:, :, None] >> (7 - jnp.arange(8, dtype=jnp.int32))) & 1
-            ).reshape(K, R8)                                     # MSB-first
-            n_pairs = jnp.sum(bits, dtype=jnp.int32)
-            (flat,) = jnp.nonzero(bits.reshape(-1), size=P, fill_value=0)
-            k = flat // R8
-            col = flat - k * R8
-            caller = jnp.take(c["idx_caller_k"], k)              # [P]
-            live = jax.lax.iota(jnp.int32, P) < n_pairs
-            pairs = jnp.where(live, caller * R8 + col, -1)
+            pairs, n_pairs, _ = self.pairs_from_core(c, K, P)
             parts = [
                 ((c["n_cand"][None] >> shifts) & 0xFF).astype(jnp.uint8),
                 ((n_pairs[None] >> shifts) & 0xFF).astype(jnp.uint8),

@@ -534,7 +534,7 @@ class TpuMatcher(Matcher):
 
         def collect_replay(e):
             res = self._fw_pipeline.collect(e["pend"])
-            sparse = (res.matched_rows, res.matched_bits, res.always_bits)
+            sparse = (res.matched_pairs, res.always_bits)
             self._replay_window_events(
                 e["work"], None, sparse, res.events, results
             )
@@ -658,9 +658,7 @@ class TpuMatcher(Matcher):
                 except PipelineOverflow as ov:
                     self._pipeline_fallback_entry(e, ov, results_c)
                     return
-                sparse = (
-                    res.matched_rows, res.matched_bits, res.always_bits
-                )
+                sparse = (res.matched_pairs, res.always_bits)
                 self._replay_window_events(
                     work_c, None, sparse, res.events, results_c
                 )
@@ -704,8 +702,8 @@ class TpuMatcher(Matcher):
             )
         finally:
             self._fw_pipeline.fallback_done(pend)
-        if bits is None and pend.matched_bits is not None:
-            sparse = (pend.matched_rows, pend.matched_bits, pend.always_bits)
+        if bits is None and pend.matched_pairs is not None:
+            sparse = (pend.matched_pairs, pend.always_bits)
             self._replay_window_events(e["work"], None, sparse, events, results)
             return
         if bits is None:
@@ -713,20 +711,17 @@ class TpuMatcher(Matcher):
         self._replay_window_events(e["work"], bits, None, events, results)
 
     def _sparse_row_sets(self, n, sparse):
-        """Per-row matched rule-id sets from the pipeline's sparse result."""
-        matched_rows, matched_bits, always_bits = sparse
+        """Per-row matched rule-id sets from the pipeline's sparse result
+        ((row, rule) pairs: caller_row * R8 + packed stage-2 bit column)."""
+        matched_pairs, always_bits = sparse
         plan = self._prefilter.plan
         row_ids: Dict[int, set] = {}
-        if matched_rows is not None and len(matched_rows):
-            unpacked = np.unpackbits(
-                matched_bits, axis=1, count=plan.stage2.n_rules
-            )
-            for k, row in enumerate(matched_rows):
-                ids = plan.f_idx[np.flatnonzero(unpacked[k])]
-                if len(ids):
-                    row_ids.setdefault(int(row), set()).update(
-                        int(x) for x in ids
-                    )
+        if matched_pairs is not None and len(matched_pairs):
+            R8 = self._prefilter._nf8 * 8
+            rows_idx, cols = matched_pairs // R8, matched_pairs % R8
+            ok = cols < plan.stage2.n_rules
+            for row, rid in zip(rows_idx[ok], plan.f_idx[cols[ok]]):
+                row_ids.setdefault(int(row), set()).add(int(rid))
         if always_bits is not None and plan.n_always:
             ab = np.unpackbits(
                 always_bits[:n], axis=1, count=plan.n_always
