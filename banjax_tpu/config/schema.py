@@ -152,12 +152,14 @@ class Config:
     # the TPU instead of the host dict. Counters reset on config reload
     # (rule ids reindex); the reference keeps them (keyed by rule name).
     matcher_device_windows: bool = False
-    # IP slots for device windows. When distinct-IP cardinality exceeds this,
-    # the LRU IP's counters are evicted and FORGOTTEN (the reference's host
-    # dict never forgets) — rules under-enforce for rotated-back IPs. The
-    # DeviceWindows.eviction_count counter / metrics line surfaces pressure;
-    # size this above the expected concurrent distinct-IP count.
-    matcher_window_capacity: int = 16384  # IP slots (LRU-evicted)
+    # IP slots for device windows. 0 (the default) = auto-size: start at
+    # 16384 and double on observed distinct-IP pressure up to a ~2 GiB
+    # device-memory ceiling, so the common case never evicts. A fixed
+    # positive count pins the table; beyond it the LRU IP's counters spill
+    # losslessly to the host shadow (restored on re-admission) at a
+    # throughput cost. DeviceWindows.eviction_count / the metrics line's
+    # DeviceWindowsEvictionsPerInterval surface the churn.
+    matcher_window_capacity: int = 0  # IP slots; 0 = auto-size
     # two-stage literal prefilter (matcher/prefilter.py): bit-identical
     # output, auto-disabled for rulesets with too few filterable rules.
     # cand_frac sizes the candidate capacity as a fraction of the batch:
@@ -282,10 +284,10 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
             "config key matcher_backend: expected "
             f"auto|xla|pallas|pallas-interpret, got {cfg.matcher_backend!r}"
         )
-    if cfg.matcher_window_capacity <= 0:
+    if cfg.matcher_window_capacity < 0:
         raise ValueError(
-            "config key matcher_window_capacity: expected a positive slot "
-            f"count, got {cfg.matcher_window_capacity}"
+            "config key matcher_window_capacity: expected 0 (auto-size) or "
+            f"a positive slot count, got {cfg.matcher_window_capacity}"
         )
     if cfg.matcher_mesh_devices < 0 or cfg.matcher_mesh_rp < 0:
         raise ValueError(

@@ -360,7 +360,15 @@ class FusedWindowsPipeline:
             if n_pairs <= P:
                 live = pairs[:n_pairs]
                 rows_idx = live // R8
-                keep = (rows_idx >= 0) & (rows_idx < p.B)
+                cols = live - rows_idx * R8
+                # same invariant as prefilter.collect: row in range AND
+                # col within the true rule count, so matched_pairs is a
+                # clean invariant at the source (consumers may index f_idx
+                # with it directly)
+                keep = (
+                    (rows_idx >= 0) & (rows_idx < p.B)
+                    & (cols < self.pf.plan.stage2.n_rules)
+                )
                 p.matched_pairs = live[keep]
             if not flags[0]:
                 p.state = "overflow"

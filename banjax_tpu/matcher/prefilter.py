@@ -397,15 +397,18 @@ class FusedPrefilter:
     native fastparse output) is consumed verbatim.
 
     Capacity: K = max(block, ceil(B * cand_frac)) compacted lines, and
-    P = ceil(B * out_frac) output (row, rule) pairs. Both counts come back
-    with the result; exceeding either raises PrefilterOverflow (soundness:
-    a truncated candidate or pair set would silently under-match) and the
-    caller reruns that batch single-stage — an adversarial all-matching
-    stream degrades to the single-stage rate, never to wrong output.
+    P = ceil(B * pair_frac) output (row, rule) pairs — pair_frac budgets
+    PAIRS PER CALLER LINE, not a matched-row fraction of K (the r3 sparse
+    rewrite changed the output encoding; the knob was renamed with it).
+    Both counts come back with the result; exceeding either raises
+    PrefilterOverflow (soundness: a truncated candidate or pair set would
+    silently under-match) and the caller reruns that batch single-stage —
+    an adversarial all-matching stream degrades to the single-stage rate,
+    never to wrong output.
     """
 
     def __init__(self, plan: PrefilterPlan, backend: str,
-                 cand_frac: float = 0.125, out_frac: float = 0.25,
+                 cand_frac: float = 0.125, pair_frac: float = 0.25,
                  block_b: int = 0, cols: int = 0):
         """Chunking is the CALLER's job: submit() compiles one device
         program for exactly the batch shape it is handed (TpuMatcher
@@ -416,7 +419,7 @@ class FusedPrefilter:
         self.backend = backend
         self.interpret = backend == "pallas-interpret"
         self.cand_frac = cand_frac
-        self.out_frac = out_frac
+        self.pair_frac = pair_frac
         self._pallas = backend in ("pallas", "pallas-interpret")
         if self._pallas:
             self._preps = {
@@ -553,7 +556,7 @@ class FusedPrefilter:
 
     def pair_capacity(self, B: int, K: int) -> int:
         """Output slots for the sparse (row, rule) pair encoding: one int32
-        per set rule bit, budgeted at `out_frac` pairs per caller line and
+        per set rule bit, budgeted at `pair_frac` pairs per caller line and
         capped by the true maximum (every candidate matching every rule)."""
         if B * self._nf8 * 8 >= 2**31:
             raise ValueError(
@@ -561,7 +564,7 @@ class FusedPrefilter:
                 "the int32 (row, rule) pair encoding — lower "
                 "matcher_batch_lines"
             )
-        return min(max(128, int(B * self.out_frac)), K * self.plan.stage2.n_rules)
+        return min(max(128, int(B * self.pair_frac)), K * self.plan.stage2.n_rules)
 
     def pairs_from_core(self, c, K: int, P: int):
         """The sparse (row, rule) pair extraction shared by the plain fused
@@ -574,6 +577,14 @@ class FusedPrefilter:
         bits = (
             (c["m2p"][:, :, None] >> (7 - jnp.arange(8, dtype=jnp.int32))) & 1
         ).reshape(K, R8)
+        # mask pad columns beyond the true rule count: n_pairs and the pair
+        # stream must be bounded by n_rules even if a packer left a pad bit
+        # set (otherwise a stray pad bit inflates n_pairs toward spurious
+        # PrefilterOverflow)
+        bits = jnp.where(
+            jnp.arange(R8, dtype=jnp.int32) < self.plan.stage2.n_rules,
+            bits, 0,
+        )
         n_pairs = jnp.sum(bits, dtype=jnp.int32)
         (flat,) = jnp.nonzero(bits.reshape(-1), size=P, fill_value=0)
         k = flat // R8

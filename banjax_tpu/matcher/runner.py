@@ -160,7 +160,7 @@ class TpuMatcher(Matcher):
 
             self.device_windows = DeviceWindows(
                 [r for _, r in self._entries],
-                capacity=getattr(config, "matcher_window_capacity", 16384),
+                capacity=getattr(config, "matcher_window_capacity", 0),
             )
             # active_table[h, rid]: rule rid applies to lines of host row h
             # (per-site rules of that host + global rules), minus

@@ -308,13 +308,33 @@ class DeviceWindows:
     pulling only the requested slots back from the device.
     """
 
+    # auto-size memory budget: device state is 13 bytes per (slot, rule)
+    # (3x int32 + valid bool) plus [capacity] ip_seen; cap the flat arrays
+    # well under the v5e-1's 16 GB HBM so the matcher never squeezes the
+    # kernels' working set
+    AUTO_START_CAPACITY = 16384
+    AUTO_MEM_BUDGET_BYTES = 2 << 30
+
     def __init__(
         self,
         rules: Sequence[RegexWithRate],
-        capacity: int = 16384,  # the matcher_window_capacity config default
+        capacity: int = 16384,  # matcher_window_capacity; 0 = auto-size
         max_events: int = 4096,
     ):
         self.n_rules = max(1, len(rules))
+        # capacity 0 = auto: start small, double on occupancy pressure
+        # (observed distinct-IP rate) up to the memory budget — an eviction
+        # is forced only once the budget ceiling is reached
+        self.auto_grow = capacity <= 0
+        if self.auto_grow:
+            self.max_capacity = max(
+                self.AUTO_START_CAPACITY,
+                int(self.AUTO_MEM_BUDGET_BYTES // (13 * self.n_rules)),
+            )
+            capacity = min(self.AUTO_START_CAPACITY, self.max_capacity)
+        else:
+            self.max_capacity = capacity
+        self.grow_count = 0
         self.capacity = capacity
         # a single line can fire every rule; max_events >= n_rules makes the
         # overflow split terminate at B=1
@@ -403,6 +423,14 @@ class DeviceWindows:
                     pinned.add(slot)
                     out[i] = slot
                     continue
+                if (
+                    not self._free
+                    and self.auto_grow
+                    and self.capacity < self.max_capacity
+                ):
+                    self._grow_locked(
+                        min(self.capacity * 2, self.max_capacity)
+                    )
                 if not self._free:
                     # evict the least-recently-used unpinned slot (skipping
                     # both this batch's slots and any still in flight from a
@@ -444,6 +472,45 @@ class DeviceWindows:
             for slot in set(out.tolist()):
                 self._pin_counts[slot] = self._pin_counts.get(slot, 0) + 1
             return out
+
+    def _grow_locked(self, new_capacity: int) -> None:
+        """Double the slot table in place (auto-size): pad the flat device
+        arrays with zeros and free-list the new high slots. Existing slot
+        ids, pending evictions/restores, and the shadow are untouched; the
+        only cost is one recompile of the apply programs at the new state
+        shape (geometric growth bounds that to ~log2(max/start) compiles
+        over the process lifetime)."""
+        old_cap = self.capacity
+        add = new_capacity - old_cap
+        if add <= 0:
+            return
+        s = self._state
+        pad_r = add * self.n_rules
+        self._state = DeviceWindowState(
+            hits=jnp.concatenate([s.hits, jnp.zeros(pad_r, jnp.int32)]),
+            start_s=jnp.concatenate([s.start_s, jnp.zeros(pad_r, jnp.int32)]),
+            start_ns=jnp.concatenate(
+                [s.start_ns, jnp.zeros(pad_r, jnp.int32)]
+            ),
+            valid=jnp.concatenate([s.valid, jnp.zeros(pad_r, jnp.bool_)]),
+            ip_seen=jnp.concatenate(
+                [s.ip_seen, jnp.zeros(add, jnp.bool_)]
+            ),
+        )
+        # pop() takes from the end: keep existing (lower) slots there so
+        # allocation order is unchanged; new high slots drain last
+        self._free = (
+            list(range(new_capacity - 1, old_cap - 1, -1)) + self._free
+        )
+        self.capacity = new_capacity
+        self.grow_count += 1
+        import logging
+
+        logging.getLogger(__name__).info(
+            "device-windows auto-grow: %d -> %d slots (distinct-IP "
+            "pressure; ceiling %d)",
+            old_cap, new_capacity, self.max_capacity,
+        )
 
     def _release_pins(self, slot_ids) -> None:
         with self._lock:

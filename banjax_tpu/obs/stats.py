@@ -25,6 +25,7 @@ class MatcherStats:
         self._lat_n = 0
         self._window_lines = 0
         self._window_start = time.monotonic()
+        self._last_evictions = 0
 
     def record_batch(self, n_lines: int, elapsed_s: float) -> None:
         with self._lock:
@@ -59,6 +60,14 @@ class MatcherStats:
             out["DeviceWindowsOccupancy"] = device_windows.occupancy
             out["DeviceWindowsCapacity"] = device_windows.capacity
             out["DeviceWindowsEvictions"] = device_windows.eviction_count
+            # churn rate: evictions in THIS reporting interval — degraded
+            # (spill/restore) mode is visible per 29 s line, not only as a
+            # lifetime total
+            out["DeviceWindowsEvictionsPerInterval"] = (
+                device_windows.eviction_count - self._last_evictions
+            )
+            self._last_evictions = device_windows.eviction_count
+            out["DeviceWindowsGrows"] = getattr(device_windows, "grow_count", 0)
             # shadowed IPs = all IPs with live counters (evicted included —
             # spill keeps them; see matcher/windows.py)
             out["DeviceWindowsShadowedIps"] = len(device_windows)

@@ -197,7 +197,7 @@ global_user_agent_decision_lists:
 """
     n_ips = 100_000 if FULL else 20_000
     m, _ = _make_matcher(
-        ua_yaml, matcher_device_windows=True, matcher_window_capacity=16_384
+        ua_yaml, matcher_device_windows=True, matcher_window_capacity=0
     )
     assert m.device_windows is not None
     now = time.time()
@@ -205,9 +205,18 @@ global_user_agent_decision_lists:
     lines = _access_log_lines(n, now, n_ips=n_ips)
     elapsed = _drive(m, lines, now)
     lps = _report(4, n, elapsed)
-    if n_ips > 16_384:
-        # eviction pressure must be VISIBLE, not silent
-        assert m.device_windows.eviction_count > 0
+    # auto-sizing (matcher_window_capacity: 0) must absorb the distinct-IP
+    # cardinality without ever evicting — the ladder's north-star config
+    # runs at full speed, not in spill/restore mode (VERDICT r3 item 4);
+    # eviction-pressure behavior itself is covered by
+    # tests/unit/test_device_windows.py with pinned small capacities
+    assert m.device_windows.eviction_count == 0, (
+        f"auto-sized windows still evicted "
+        f"{m.device_windows.eviction_count}x at {n_ips} distinct IPs"
+    )
+    if n_ips > m.device_windows.AUTO_START_CAPACITY:
+        assert m.device_windows.grow_count > 0
+        assert m.device_windows.capacity >= n_ips
     # the fused ruleset side: UA patterns ride the same device pass
     from banjax_tpu.decisions.ua_lists import build_ua_rules, check_ua_decision
     from banjax_tpu.matcher.fused import DeviceUAMatcher

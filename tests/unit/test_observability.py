@@ -65,8 +65,11 @@ def test_matcher_keys_are_additive():
     assert line["MatcherBatchLatencyP50Ms"] > 0
     assert line["MatcherBatchLatencyP99Ms"] >= line["MatcherBatchLatencyP50Ms"]
     assert line["DeviceWindowsOccupancy"] == 10
-    assert line["DeviceWindowsCapacity"] == cfg.matcher_window_capacity
+    # capacity 0 in config = auto-size; the line reports the ACTUAL table
+    assert line["DeviceWindowsCapacity"] == m.device_windows.capacity > 0
     assert line["DeviceWindowsEvictions"] == 0
+    assert line["DeviceWindowsEvictionsPerInterval"] == 0
+    assert line["DeviceWindowsGrows"] == 0
     # the lines/sec window resets per snapshot
     line2 = _line(m)
     assert line2["MatcherLinesPerSec"] == 0

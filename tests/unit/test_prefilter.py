@@ -214,7 +214,7 @@ class TestFusedFuzz:
         cls_ids, lens, _, want = _single_stage_oracle(
             compiled, plan, lines, max_len=96
         )
-        fp = FusedPrefilter(plan, "xla", cand_frac=1.0, out_frac=1.0)
+        fp = FusedPrefilter(plan, "xla", cand_frac=1.0, pair_frac=1.0)
         got = fp.match_bits_encoded(cls_ids, lens)
         np.testing.assert_array_equal(got, want)
         # oracle the oracle: spot-check against Python re
@@ -254,7 +254,7 @@ class TestFusedPrefilter:
         assert plan is not None
         cls_ids, lens, he, want = self._oracle(compiled, plan, lines)
         assert not he.any()
-        fp = FusedPrefilter(plan, backend, cand_frac=1.0, out_frac=1.0)
+        fp = FusedPrefilter(plan, backend, cand_frac=1.0, pair_frac=1.0)
         bits = fp.match_bits_encoded(cls_ids, lens)
         np.testing.assert_array_equal(bits, want)
 
@@ -284,10 +284,10 @@ class TestFusedPrefilter:
         compiled, plan = self._plan(patterns)
         assert plan is not None
         cls_ids, lens, _, want = self._oracle(compiled, plan, lines)
-        fp = FusedPrefilter(plan, "xla", cand_frac=1.0, out_frac=1.0)
+        fp = FusedPrefilter(plan, "xla", cand_frac=1.0, pair_frac=1.0)
         assert fp._pack_input  # packed is the default on LE hosts
         packed = fp.match_bits_encoded(cls_ids, lens)
-        fp2 = FusedPrefilter(plan, "xla", cand_frac=1.0, out_frac=1.0)
+        fp2 = FusedPrefilter(plan, "xla", cand_frac=1.0, pair_frac=1.0)
         fp2._pack_input = False
         unpacked = fp2.match_bits_encoded(cls_ids, lens)
         np.testing.assert_array_equal(packed, want)
@@ -317,7 +317,7 @@ class TestFusedPrefilter:
         patterns = bench.generate_rules(40, seed=3)
         compiled, plan = self._plan(patterns)
         assert plan is not None
-        fp = FusedPrefilter(plan, "xla", cand_frac=1.0, out_frac=1.0)
+        fp = FusedPrefilter(plan, "xla", cand_frac=1.0, pair_frac=1.0)
         batches = [
             bench.generate_lines(100, patterns, seed=s, attack_rate=0.2)
             for s in (1, 2, 3)
