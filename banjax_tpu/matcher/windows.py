@@ -327,9 +327,11 @@ class DeviceWindows:
         # is forced only once the budget ceiling is reached
         self.auto_grow = capacity <= 0
         if self.auto_grow:
+            # the budget is a CEILING: a huge ruleset shrinks both the
+            # ceiling and the start size (the 256-slot floor just keeps the
+            # table functional); the start never exceeds the budget
             self.max_capacity = max(
-                self.AUTO_START_CAPACITY,
-                int(self.AUTO_MEM_BUDGET_BYTES // (13 * self.n_rules)),
+                256, int(self.AUTO_MEM_BUDGET_BYTES // (13 * self.n_rules))
             )
             capacity = min(self.AUTO_START_CAPACITY, self.max_capacity)
         else:
@@ -451,12 +453,18 @@ class DeviceWindows:
                     if self.eviction_count == 0:
                         import logging
 
+                        hint = (
+                            "auto-size hit its memory-budget ceiling — "
+                            "more HBM or fewer rules would raise it"
+                            if self.auto_grow else
+                            "raise matcher_window_capacity (or set 0 = "
+                            "auto-size) to avoid the churn"
+                        )
                         logging.getLogger(__name__).warning(
                             "device-windows capacity (%d slots) exceeded; "
                             "evicting LRU IP state to the host shadow "
-                            "(restored on re-admission — raise "
-                            "matcher_window_capacity to avoid the churn)",
-                            self.capacity,
+                            "(restored on re-admission — %s)",
+                            self.capacity, hint,
                         )
                     self.eviction_count += 1
                 slot = self._free.pop()

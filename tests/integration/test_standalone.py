@@ -265,3 +265,114 @@ def test_fail_open_on_handler_crash(app_factory):
         app.static_lists.check_per_site = original
     # and the server still serves
     assert auth("/").status_code == 200
+
+
+def _reload_to(app, tmp_path, fixture_name: str) -> None:
+    """Swap the on-disk config and run the SIGHUP handler body
+    (banjax_base_test.go reloadConfig)."""
+    shutil.copy(FIXTURES / fixture_name, tmp_path / "banjax-config.yaml")
+    app.reload()
+
+
+def test_cidr_matrix_and_reload(app_factory, tmp_path):
+    """The CIDR decision-mask matrices driven through the real server +
+    reload (banjax_integration_test.go:42-66 with
+    fixtures/banjax-config-test-reload-cidr.yaml)."""
+    app = app_factory("banjax-config-test.yaml")
+
+    # a CIDR string sent AS a client IP is not an IP: skipped, not matched
+    assert auth("/global_mask_noban", ip="192.168.1.0/24").status_code == 200
+    # member of the global challenge mask 192.168.1.0/24
+    assert auth("/global_mask_64_ban", ip="192.168.1.64").status_code == 429
+    # outside every mask
+    assert auth("/global_mask_bypass", ip="192.168.87.87").status_code == 200
+    # per-site challenge mask 192.168.0.0/24 (localhost:8081)
+    assert auth("/per_site_mask_noban", ip="192.168.0.0/24").status_code == 200
+    assert auth("/per_site_mask_128_ban", ip="192.168.0.128").status_code == 429
+    # per-site password ttl present pre-reload (max-age=3600)
+    assert b"max-age=3600" in auth("wp-admin/x").content
+
+    _reload_to(app, tmp_path, "banjax-config-test-reload-cidr.yaml")
+    r = requests.get(f"{BASE}/info", timeout=5)
+    assert r.json()["config_version"] == "2022-03-02_00:00:01"
+    # new global nginx_block mask 192.168.2.0/24
+    assert auth("/global_mask_64_nginx_block", ip="192.168.2.64").status_code == 403
+    # the 192.168.1.0/24 challenge mask is gone
+    assert auth("/global_mask_64_no_cha", ip="192.168.1.64").status_code == 200
+    # per-site: challenge mask removed, nginx_block mask added
+    assert auth("/per_site_mask_noban_128", ip="192.168.0.128").status_code == 200
+    assert auth("/per_site_mask_noban_128", ip="192.168.3.128").status_code == 403
+    # per-site ttl dropped: password page falls back to the global default
+    assert b"max-age=14400" in auth("wp-admin/x").content
+
+
+def test_sitewide_sha_inv_reload_cycle(app_factory, tmp_path):
+    """sitewide_sha_inv_list on -> challenge everything -> off again
+    (banjax_integration_test.go:409-435), including actually SOLVING the
+    sitewide challenge while it is on."""
+    app = app_factory("banjax-config-test.yaml")
+    assert auth("/1").status_code == 200  # list off
+
+    _reload_to(app, tmp_path, "banjax-config-test-sha-inv.yaml")
+    r = requests.get(f"{BASE}/info", timeout=5)
+    assert r.json()["config_version"] == "2022-02-03_00:00:02"
+    r = auth("/2")
+    assert r.status_code == 429  # every path challenged now
+    assert "deflect_challenge3" in r.cookies
+    unsolved = go_query_unescape(r.cookies["deflect_challenge3"])
+    solved = solve_challenge_for_testing(unsolved, 10)
+    r = auth("/2", cookies={"deflect_challenge3": solved})
+    assert r.status_code == 200
+    assert r.headers["X-Banjax-Decision"] == "ShaChallengePassed"
+
+    _reload_to(app, tmp_path, "banjax-config-test.yaml")
+    assert auth("/3").status_code == 200  # list off again
+
+
+def test_persite_fail_allowlisted_lockout_cycle(app_factory, tmp_path):
+    """Failed-password lockout at threshold 3 for an ALLOWLISTED client:
+    401 x3, one 403 (the lockout fires and resets), then 401 again — the
+    per-site allow (exact IP and CIDR member alike) exempts the client
+    from the expiring block the lockout inserted
+    (banjax_integration_test.go:232-250 with
+    fixtures/banjax-config-test-persite-fail.yaml)."""
+    app = app_factory("banjax-config-test.yaml")
+    _reload_to(app, tmp_path, "banjax-config-test-persite-fail.yaml")
+    r = requests.get(f"{BASE}/info", timeout=5)
+    assert r.json()["config_version"] == "2023-08-23_00:00:01"
+
+    for ip in ("92.92.92.92", "192.168.1.87"):
+        statuses = [auth("/wp-admin", ip=ip).status_code for _ in range(5)]
+        assert statuses == [401, 401, 401, 403, 401], (ip, statuses)
+
+
+def test_user_agent_precedence_matrix(app_factory, tmp_path):
+    """Global UA block/challenge patterns and the per-site UA allow
+    override, including precedence against a global challenge IP
+    (banjax_integration_test.go:437-463 + TestPerSiteUserAgentDecisionLists
+    with fixtures/banjax-config-test-ua.yaml)."""
+    app = app_factory("banjax-config-test.yaml")
+    _reload_to(app, tmp_path, "banjax-config-test-ua.yaml")
+    r = requests.get(f"{BASE}/info", timeout=5)
+    assert r.json()["config_version"] == "2025-01-01_00:00:01"
+
+    ahrefs = "Mozilla/5.0 (compatible; AhrefsBot/7.0; +http://ahrefs.com/robot/)"
+    semrush = "Mozilla/5.0 (compatible; SemrushBot/7.0; +http://www.semrush.com/bot.html)"
+    ff_mac = "Mozilla/5.0 (Macintosh; Intel Mac OS X 10.15; rv:149.0) Gecko/20100101 Firefox/149.0"
+    ff_win = "Mozilla/5.0 (Windows NT 10.0; Win64; x64; rv:149.0) Gecko/20100101 Firefox/149.0"
+    gbot = "Mozilla/5.0 (compatible; Googlebot/2.1; +http://www.google.com/bot.html)"
+    gpt = "Mozilla/5.0 (compatible; GPTBot/1.0; +https://openai.com/gptbot)"
+
+    assert auth("/ua_ahref", ua=ahrefs).status_code == 403
+    assert auth("/ua_semrush", ua=semrush).status_code == 403
+    assert auth("/ua_firefox_mac", ua=ff_mac).status_code == 429
+    assert auth("/ua_firefox_win", ua=ff_win).status_code == 200
+    assert auth("/ua_googlebot", ua=gbot).status_code == 200
+
+    # precedence against the global challenge IP 8.8.8.8:
+    assert auth("/ua_ip_challenge", ip="8.8.8.8").status_code == 429
+    # per-site UA allow overrides the global IP challenge
+    assert auth("/ua_gptbot_override", ip="8.8.8.8", ua=gpt).status_code == 200
+    # no per-site rule for AhrefsBot: the IP challenge fires before the
+    # global UA block
+    assert auth("/ua_ahref_challenged_ip", ip="8.8.8.8", ua=ahrefs).status_code == 429
