@@ -24,8 +24,9 @@ the mercy of XLA's gather lowering; this kernel instead
 
 Layout is TRANSPOSED versus nfa_jax: state is [W, block] — NFA words on
 sublanes, lines on lanes. That makes the cross-word carry a sublane roll,
-lets every mask slice be tiling-aligned (wps_p is a lane multiple), and
-gives the per-byte column DMA a [8, block] tile. The byte position is the
+lets every mask slice be tiling-aligned (wps_p is a KERNEL_WORD_ALIGN = 32
+multiple: the int8 sublane tile, which every in-kernel slice satisfies),
+and gives the per-byte column DMA a [cols, block] tile. The byte position is the
 innermost (sequential) grid axis: the Pallas pipeline double-buffers each
 byte-row tile while the previous one computes; NFA state lives in VMEM
 scratch across grid steps (reset at byte 0), accept bits accumulate into
@@ -51,7 +52,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from banjax_tpu.matcher.rulec import CompiledRules
+from banjax_tpu.matcher.rulec import KERNEL_WORD_ALIGN, CompiledRules
 
 # mask-column indices in the packed [W, 8] uint32 mask tensor
 _SHIFT_IN, _INJ_ALWAYS, _INJ_START, _SELFLOOP, _ACC_ANY, _ACC_END = range(6)
@@ -74,7 +75,7 @@ class PallasRules:
     n_rules: int
     n_shards: int
     wps: int             # original words per shard
-    wps_p: int           # padded to a lane multiple
+    wps_p: int           # padded to a KERNEL_WORD_ALIGN (32) multiple
     n_classes_p: int     # padded to a lane multiple (it's the dot's lane axis)
     btab_t: jnp.ndarray  # [n_shards * 4 * wps_p, C_p] int8 — 4 byte planes, biased -128
     masks_t: jnp.ndarray  # [n_shards * wps_p, 8] uint32
@@ -114,11 +115,10 @@ def _pad_to(n: int, mult: int) -> int:
 def auto_shards(n_words: int, max_wps: int = 512) -> int:
     """Shard count minimizing total padded words (the dot's row axis).
 
-    Each shard's word slab pads up to a lane multiple, so the FLOP cost is
-    `n_shards * pad(ceil(n_words / n_shards), 128)`; e.g. 2261 words cost
-    3072 padded words at 6 shards but 2304 at 9. Ties break toward fewer
-    shards (fewer grid steps). `max_wps` caps the slab so the per-step
-    VMEM transients stay comfortable at block=256.
+    Each shard's word slab pads up to a KERNEL_WORD_ALIGN multiple, so the
+    FLOP cost is `n_shards * pad(ceil(n_words / n_shards), align)`. Ties
+    break toward fewer shards (fewer grid steps). `max_wps` caps the slab
+    so the per-step VMEM transients stay comfortable at block=256.
     """
     if n_words <= 0:
         return 1
@@ -127,7 +127,7 @@ def auto_shards(n_words: int, max_wps: int = 512) -> int:
         # 4% slack over the even split: rulec's branch-atomic greedy packing
         # can overfill the fullest shard slightly beyond ceil(n_words / ns)
         wps_est = -(-n_words * 26 // (25 * ns))
-        wps_p = max(_LANE, _pad_to(wps_est, _LANE))
+        wps_p = max(KERNEL_WORD_ALIGN, _pad_to(wps_est, KERNEL_WORD_ALIGN))
         if wps_p > max_wps:
             continue
         cost = ns * wps_p
@@ -139,7 +139,7 @@ def auto_shards(n_words: int, max_wps: int = 512) -> int:
 def prepare(compiled: CompiledRules) -> PallasRules:
     """Repack a compiled ruleset for the kernel.
 
-    Each shard's `wps` words are padded independently to a lane multiple so
+    Each shard's `wps` words are padded independently to a KERNEL_WORD_ALIGN multiple so
     a grid step over shard j addresses a self-contained, aligned word slab;
     accept-word indices are remapped to match. Padding words carry all-zero
     masks, so any state bit shifted into them is annihilated by `& bmask`.
@@ -152,7 +152,13 @@ def prepare(compiled: CompiledRules) -> PallasRules:
     would save, so the per-column accumulation stays.)
     """
     ns, wps = compiled.n_shards, compiled.words_per_shard
-    wps_p = max(_LANE, _pad_to(wps, _LANE))
+    # pad each slab to the int8 sublane tile (32), not the full lane (128):
+    # every in-kernel slice stays tiling-aligned (btab plane slices at
+    # multiples of W with 4W a 128-multiple; [W, 8] mask rows and the
+    # [W, block] state need only 8) and the VPU scan — the measured
+    # critical path — runs 4x fewer word rows for a ~40-word stage-1
+    # automaton. BANJAX_NFA_WORD_ALIGN=128 restores the conservative pad.
+    wps_p = max(KERNEL_WORD_ALIGN, _pad_to(wps, KERNEL_WORD_ALIGN))
     if wps_p > _MAX_WORDS_PER_SHARD:
         raise PallasUnsupported(
             f"{wps_p} words/shard exceeds the VMEM budget "

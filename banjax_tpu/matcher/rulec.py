@@ -43,6 +43,7 @@ the final byte for `$`-anchored branches (`accept_end`).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -785,31 +786,41 @@ def compile_rules(patterns: Sequence[str], n_shards=1) -> CompiledRules:
     return pack_programs(programs, n_shards=n_shards, unsupported=unsupported)
 
 
-_KERNEL_LANE_WORDS = 128   # the Pallas kernel pads each shard to this multiple
-_KERNEL_MAX_WPS = 512      # its per-shard VMEM comfort budget
+# The Pallas kernel pads each shard's word slab to this multiple. The VPU
+# scan cost is ∝ the PADDED word count, so a small automaton (the fused
+# prefilter's ~40-word stage 1) wastes 3-4x work at 128. 32 — the int8
+# sublane tile, the tightest alignment every in-kernel slice (btab plane
+# slices at multiples of W, [W, 8] mask rows, the [W, block] state) still
+# satisfies — is the default; BANJAX_NFA_WORD_ALIGN=128 restores the old
+# conservative padding if a Mosaic version rejects 32-row slabs.
+KERNEL_WORD_ALIGN = int(os.environ.get("BANJAX_NFA_WORD_ALIGN", "32") or 32)
+if KERNEL_WORD_ALIGN not in (32, 64, 128):
+    raise ValueError(
+        f"BANJAX_NFA_WORD_ALIGN={KERNEL_WORD_ALIGN!r}: must be 32, 64, or "
+        "128 (multiples of the int8 sublane tile up to the lane width)"
+    )
+_KERNEL_MAX_WPS = 512      # the kernel's per-shard VMEM comfort budget
 
 
-def choose_shards(branch_lengths: Sequence[int]) -> int:
+def choose_shards(branch_lengths: Sequence[int], align: int = 0) -> int:
     """Exact-cost shard count: simulate the greedy branch packing for each
-    candidate and minimize `n_shards * pad(real_words_per_shard, lane)` —
+    candidate and minimize `n_shards * pad(real_words_per_shard, align)` —
     the dot-row count the kernel actually pays (a ceil(total/ns) estimate
-    misses the packer's imbalance and can land just past a lane boundary)."""
+    misses the packer's imbalance and can land just past a pad boundary)."""
     if not branch_lengths:
         return 1
+    align = align or KERNEL_WORD_ALIGN
     order = sorted(branch_lengths, reverse=True)
     total = sum(order)
     best, best_cost = 1, None
-    max_ns = max(1, -(-total // (_KERNEL_LANE_WORDS * 32 // 2)))
+    max_ns = max(1, -(-total // (128 * 32 // 2)))
     for ns in range(1, max_ns + 1):
         bits = [0] * ns
         for ln in order:
             s = min(range(ns), key=bits.__getitem__)
             bits[s] += ln
         wps = -(-max(bits) // 32)
-        wps_p = max(
-            _KERNEL_LANE_WORDS,
-            -(-wps // _KERNEL_LANE_WORDS) * _KERNEL_LANE_WORDS,
-        )
+        wps_p = max(align, -(-wps // align) * align)
         if wps_p > _KERNEL_MAX_WPS:
             continue
         cost = ns * wps_p

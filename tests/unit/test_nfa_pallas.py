@@ -240,3 +240,26 @@ class TestRunnerBackend:
             for ra, rb in zip(a.rule_results, b.rule_results):
                 assert ra.rule_name == rb.rule_name
                 assert ra.regex_match == rb.regex_match
+
+
+def test_word_align_32_and_128_agree(monkeypatch):
+    """The sub-lane (32) and conservative lane (128) shard paddings produce
+    identical match bitmaps — the padding is dead words only (interpret
+    mode; the compiled-Mosaic tiling of the 32-row slabs is verified on
+    hardware by bench.py's pallas parity assert)."""
+    from banjax_tpu.matcher import rulec as rulec_mod
+    from banjax_tpu.matcher.kernels import nfa_match as nm
+
+    patterns = [r"GET /admin/[a-z]+\.php", r"(?i)sqlmap", r"POST /wp[0-9]{1,3}"]
+    lines = ["GET /admin/shell.php x", "Mozilla SQLMap/1.0", "POST /wp42",
+             "benign / nothing", ""]
+    outs = {}
+    for align in (32, 128):
+        monkeypatch.setattr(rulec_mod, "KERNEL_WORD_ALIGN", align)
+        monkeypatch.setattr(nm, "KERNEL_WORD_ALIGN", align)
+        compiled = compile_rules(patterns, n_shards="auto")
+        prep = nm.prepare(compiled)
+        assert prep.wps_p % align == 0
+        cls, lens, _ = encode_for_match(compiled, lines, 64)
+        outs[align] = nm.match_batch_pallas(prep, cls, lens, interpret=True)
+    assert (outs[32] == outs[128]).all()
