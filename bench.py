@@ -257,7 +257,7 @@ class _Deadline:
         return False
 
 
-def _sec_single_stage(jax, ctx, backend, deadline) -> dict:
+def _sec_single_stage(jax, ctx, backend, deadline, out) -> dict:
     """Single-stage device NFA classification (the r1/r2 headline path)."""
     import jax.numpy as jnp
 
@@ -266,7 +266,6 @@ def _sec_single_stage(jax, ctx, backend, deadline) -> dict:
     from banjax_tpu.matcher.kernels import nfa_match
     from banjax_tpu.matcher.rulec import compile_rules
 
-    out: dict = {}
     patterns = ctx["patterns"]
     batch = ctx["batch"]
     t0 = time.perf_counter()
@@ -328,7 +327,7 @@ def _sec_single_stage(jax, ctx, backend, deadline) -> dict:
     return out
 
 
-def _sec_fused(jax, ctx, backend, deadline) -> dict:
+def _sec_fused(jax, ctx, backend, deadline, out) -> dict:
     """Fused two-stage prefilter: device-resident (chained, no per-iter
     transport) AND pipelined submit/collect (the honest
     classified-through-transport rate)."""
@@ -339,7 +338,6 @@ def _sec_fused(jax, ctx, backend, deadline) -> dict:
     from banjax_tpu.matcher import nfa_jax
     from banjax_tpu.matcher.rulec import compile_rules
 
-    out: dict = {}
     patterns = ctx["patterns"]
     compiled = ctx.get("compiled")
     if compiled is None:
@@ -429,7 +427,7 @@ def _sec_fused(jax, ctx, backend, deadline) -> dict:
     return out
 
 
-def _sec_e2e(jax, ctx, backend, deadline) -> dict:
+def _sec_e2e(jax, ctx, backend, deadline, out) -> dict:
     """End-to-end consume_lines: native parse + encode + fused device match
     + device windows + Banner replay. Reports throughput and the per-batch
     latency distribution (p50/p99) — the p99 Decision latency proxy: a
@@ -442,7 +440,6 @@ def _sec_e2e(jax, ctx, backend, deadline) -> dict:
     from banjax_tpu.matcher.runner import TpuMatcher
     from tests.mock_banner import MockBanner
 
-    out: dict = {}
     patterns = ctx["patterns"]
     # one consume_lines burst of several chunks exercises the overlapped
     # two-program pipeline (chunk N's pulls hide behind N+1's compute)
@@ -496,7 +493,7 @@ def _sec_e2e(jax, ctx, backend, deadline) -> dict:
     return out
 
 
-def _sec_mesh(jax, ctx, backend, deadline) -> dict:
+def _sec_mesh(jax, ctx, backend, deadline, out) -> dict:
     """The sharded mesh path executed COMPILED on the attached backend with
     a degenerate dp=1/rp=1 mesh — the execution record that parallel/mesh.py
     runs the same code path the 8-device dryrun validates, on real silicon
@@ -506,7 +503,6 @@ def _sec_mesh(jax, ctx, backend, deadline) -> dict:
     from banjax_tpu.matcher.prefilter import build_plan
     from banjax_tpu.matcher.rulec import compile_rules
 
-    out: dict = {}
     patterns = ctx["patterns"]
     compiled = ctx.get("compiled")
     if compiled is None:
@@ -538,7 +534,7 @@ def _sec_mesh(jax, ctx, backend, deadline) -> dict:
     return out
 
 
-def _sec_ladder(jax, ctx, backend, deadline) -> dict:
+def _sec_ladder(jax, ctx, backend, deadline, out) -> dict:
     """The five BASELINE.json configs (tests/perf shapes) on the attached
     backend; one config failing keeps the rest."""
     import io
@@ -546,7 +542,7 @@ def _sec_ladder(jax, ctx, backend, deadline) -> dict:
 
     from tests.perf import test_baseline_ladder as ladder
 
-    out = {}
+    lad = {}
     for n, fn in (
         (1, ladder.test_config1_single_rule_replay_cpu_reference),
         (2, ladder.test_config2_default_ruleset_batch),
@@ -555,15 +551,17 @@ def _sec_ladder(jax, ctx, backend, deadline) -> dict:
         (5, ladder.test_config5_kafka_fed_stream_device_windows),
     ):
         if deadline.over(f"ladder_config{n}"):
-            out[f"config{n}"] = None
+            lad[f"config{n}"] = None
+            out["ladder"] = lad
             continue
         buf = io.StringIO()
         try:
             with redirect_stdout(buf):
                 fn()
-            out[f"config{n}"] = json.loads(
+            lad[f"config{n}"] = json.loads(
                 buf.getvalue().strip().splitlines()[-1]
             )["lines_per_sec"]
+            out["ladder"] = lad
         except Exception as exc:  # noqa: BLE001 — one config failing keeps the rest
             measured = None
             for line in reversed(buf.getvalue().strip().splitlines()):
@@ -572,11 +570,12 @@ def _sec_ladder(jax, ctx, backend, deadline) -> dict:
                     break
                 except (json.JSONDecodeError, AttributeError):
                     continue
-            out[f"config{n}"] = {
+            lad[f"config{n}"] = {
                 "lines_per_sec": measured,
                 "error": f"{type(exc).__name__}: {exc}",
             }
-    return {"ladder": out}
+            out["ladder"] = lad
+    return out
 
 
 _SECTION_FNS = {
@@ -610,10 +609,13 @@ def worker_main(backend: str, budget_s: float, only: "list | None") -> None:
     for name in sections:
         if deadline.over(name):
             continue
+        data: dict = {}
         try:
-            data = _SECTION_FNS[name](jax, ctx, actual, deadline)
-        except Exception as exc:  # noqa: BLE001 — persist the failure, keep going
-            data = {"error": f"{type(exc).__name__}: {exc}"}
+            _SECTION_FNS[name](jax, ctx, actual, deadline, data)
+        except Exception as exc:  # noqa: BLE001 — persist the failure AND
+            # whatever the section measured before it (e.g. the XLA numbers
+            # survive a Mosaic lowering reject later in the same section)
+            data["error"] = f"{type(exc).__name__}: {exc}"
         data["section_elapsed_s"] = round(time.monotonic() - deadline.t0, 1)
         _save_section(name, actual, data)
         print(f"[bench-worker] {name} done on {actual}", file=sys.stderr)
