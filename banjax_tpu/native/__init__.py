@@ -213,15 +213,14 @@ def parse_encode_batch(
     blob_ptr = buf.ctypes.data_as(u8p) if buf.size else ctypes.cast(
         ctypes.c_char_p(b""), u8p
     )
+    got = lib.fp_split_lines(blob_ptr, len(blob), P(starts, i64p), P(ends, i64p), n)
     # embedded newline inside a "line" (callers pass tailer lines, which
     # cannot contain one) would shift every subsequent span: fall back
-    # rather than misattribute. Checked on the blob directly — the split
-    # itself caps at n lines and so cannot detect the overflow.
-    if blob.count(b"\n") != n - 1:
+    # rather than misattribute. Detection rides the split itself (no extra
+    # blob scan): extra newlines make the capped split stop short of the
+    # blob end (or, for a trailing newline, return n-1 lines).
+    if got != n or int(ends[n - 1]) != len(blob):
         return None
-    got = lib.fp_split_lines(blob_ptr, len(blob), P(starts, i64p), P(ends, i64p), n)
-    if got != n:
-        return None  # defensive: e.g. a trailing empty final line
 
     table = np.ascontiguousarray(byte_to_class[:256], dtype=np.int32)
 

@@ -67,6 +67,41 @@ static int plain_float_span(const uint8_t *s, int64_t n) {
     return digits > 0;
 }
 
+/* Fast path for the overwhelmingly common timestamp shape
+ * "digits[.digits]": exact int64 mantissa m and exact power of ten give a
+ * single correctly-rounded division, which equals glibc's correctly-
+ * rounded strtod — so the result is bit-identical to the slow path (and
+ * therefore to Python float()) whenever this returns 1. Anything else
+ * (sign, exponent, > 2^53 mantissa, > 18 fraction digits) falls back. */
+static int fast_ts(const uint8_t *s, int64_t n, double *out) {
+    static const double p10[] = {1,    1e1,  1e2,  1e3,  1e4,  1e5,  1e6,
+                                 1e7,  1e8,  1e9,  1e10, 1e11, 1e12, 1e13,
+                                 1e14, 1e15, 1e16, 1e17, 1e18};
+    int64_t m = 0;
+    int fd = 0, seen_dot = 0, digits = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint8_t c = s[i];
+        if (c >= '0' && c <= '9') {
+            if (m >= (int64_t)922337203685477580LL) /* next *10 overflows */
+                return 0;
+            m = m * 10 + (c - '0');
+            digits++;
+            if (seen_dot)
+                fd++;
+        } else if (c == '.' && !seen_dot) {
+            seen_dot = 1;
+        } else {
+            return 0;
+        }
+    }
+    if (!digits || fd > 18)
+        return 0;
+    if (m > ((int64_t)1 << 53)) /* (double)m no longer exact */
+        return 0;
+    *out = (double)m / p10[fd];
+    return 1;
+}
+
 /* One parsed line record; offsets index into the blob. */
 typedef struct {
     int64_t ts_ns;
@@ -143,14 +178,17 @@ int64_t fp_parse_encode(
             const uint8_t *rest = sp2 + 1;
             int64_t restlen = len - (rest - line);
 
-            if (!plain_float_span(line, ts_len)) {
-                r.flags = FLAG_DEFER; /* Python float() may disagree */
-                goto store;
+            double ts;
+            if (!fast_ts(line, ts_len, &ts)) {
+                if (!plain_float_span(line, ts_len)) {
+                    r.flags = FLAG_DEFER; /* Python float() may disagree */
+                    goto store;
+                }
+                char tsbuf[80];
+                memcpy(tsbuf, line, (size_t)ts_len);
+                tsbuf[ts_len] = 0;
+                ts = strtod(tsbuf, NULL);
             }
-            char tsbuf[80];
-            memcpy(tsbuf, line, (size_t)ts_len);
-            tsbuf[ts_len] = 0;
-            double ts = strtod(tsbuf, NULL);
             double scaled = ts * 1e9;
             if (!(scaled > -9.2e18 && scaled < 9.2e18)) {
                 r.flags = FLAG_DEFER; /* int64 overflow: Python raises */

@@ -124,3 +124,60 @@ def test_throughput_beats_python_parse():
     python_s = time.perf_counter() - t0
     print(f"native {len(lines)/native_s:,.0f} lps vs python {len(lines)/python_s:,.0f} lps")
     assert native_s * 2 < python_s  # conservative: usually 10-30x
+
+
+def test_fast_timestamp_path_bit_identical_to_python_float():
+    """The C fast_ts integer fast path must agree bit-for-bit with Python
+    int(float(ts) * 1e9) on every shape it accepts; shapes it rejects must
+    defer/error into the Python re-parse path (exactness contract of
+    fastparse.c). Fuzzes plain, long-fraction, huge-mantissa, exponent,
+    and malformed timestamps."""
+    import random
+
+    import numpy as np
+
+    from banjax_tpu import native
+    from banjax_tpu.native import FLAG_DEFER, FLAG_ERROR, ParseScratch
+
+    rng = random.Random(1234)
+    cases = []
+    for _ in range(2000):
+        kind = rng.random()
+        if kind < 0.3:
+            cases.append(
+                f"{rng.randrange(10**9, 2 * 10**9)}.{rng.randrange(10**6):06d}"
+            )
+        elif kind < 0.5:
+            fd = rng.randrange(1, 18)
+            cases.append(f"{rng.randrange(10**9)}.{rng.randrange(10**fd):0{fd}d}")
+        elif kind < 0.6:
+            cases.append(str(rng.randrange(10 ** rng.randrange(1, 19))))
+        elif kind < 0.7:  # mantissa past 2^53: must take the strtod path
+            cases.append(f"{rng.randrange(10**17, 10**18)}.{rng.randrange(10**6):06d}")
+        elif kind < 0.8:  # exponent form: strtod path
+            cases.append(f"{rng.randrange(10**9)}e{rng.randrange(-3, 4)}")
+        elif kind < 0.9:
+            cases.append(f"{rng.randrange(10**9)}.{'9' * rng.randrange(1, 25)}")
+        else:
+            cases.append(rng.choice(
+                ["1_000.5", "inf", "nan", "0x1p3",
+                 f".{rng.randrange(10**6)}", f"{rng.randrange(10**6)}."]
+            ))
+    # deterministic int64-overflow boundary shapes: the fast-path mantissa
+    # accumulator must bail BEFORE m*10 wraps (a wrapped value can sneak
+    # under the 2^53 check and silently misparse)
+    cases += [
+        "922337203685477580", "9223372036854775807", "9223372036854775808",
+        "92233720368547758089", "92233720368547758085.5",
+        "922337203685477580.8", "18446744073709551616",
+    ]
+    b2c = np.zeros(257, dtype=np.int32)
+    lines = [f"{ts} 1.2.3.4 GET h.com GET / x" for ts in cases]
+    pb = native.parse_encode_batch(lines, b2c, 64, 2e9, 1e18, ParseScratch())
+    if pb is None:
+        pytest.skip("no C compiler in this environment")
+    for i, ts in enumerate(cases):
+        if int(pb.flags[i]) & (FLAG_DEFER | FLAG_ERROR):
+            continue  # python re-parse path: exact by construction
+        want = int(float(ts) * 1e9)  # raises -> C wrongly accepted it
+        assert int(pb.ts_ns[i]) == want, ts
