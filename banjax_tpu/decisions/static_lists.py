@@ -137,10 +137,15 @@ class StaticDecisionLists:
 
     def __init__(self, config: Config):
         self._snapshot = _snapshot_from_config(config)
+        # public change counter: callers caching per-(host, ip) results
+        # (TpuMatcher's allowlist cache) key on this and must discard on
+        # any bump — never on identity of private internals
+        self.generation = 0
 
     def update_from_config(self, config: Config) -> None:
         # Build fully, then swap — readers never see a partial snapshot.
         self._snapshot = _snapshot_from_config(config)
+        self.generation += 1
 
     def check_per_site(self, site: str, client_ip: str) -> Tuple[Optional[Decision], bool]:
         """decision.go:115-139 — exact map first, then per-decision filters in order."""

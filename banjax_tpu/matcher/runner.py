@@ -42,6 +42,12 @@ from banjax_tpu.matcher import nfa_jax
 from banjax_tpu.matcher.api import ConsumeLineResult, Matcher, RuleResult
 from banjax_tpu.matcher.cpu_ref import OLD_LINE_CUTOFF_SECONDS
 from banjax_tpu.matcher.encode import ParsedLine, encode_for_match, parse_line
+from banjax_tpu.matcher.workset import (
+    LazyResults,
+    ListWork,
+    NativeWork,
+    unique_spans,
+)
 from banjax_tpu.matcher.rulec import compile_rules
 
 log = logging.getLogger(__name__)
@@ -121,6 +127,11 @@ class TpuMatcher(Matcher):
         # per-line parse loop; per-line semantics identical (defer contract)
         self._native = False
         self._parse_scratch = None
+        self._dedup_scratch = None
+        # allowlist results per distinct (host, ip), valid for one
+        # static-lists snapshot (cleared on hot reload / size bound)
+        self._allow_cache: Dict[Tuple[str, str], bool] = {}
+        self._allow_cache_snap = None
         if getattr(config, "matcher_native_parse", True):
             from banjax_tpu import native as _native
 
@@ -130,6 +141,7 @@ class TpuMatcher(Matcher):
                 # page faults per 65k batch; each batch is fully consumed
                 # (all reads are copies) before the next parse reuses them
                 self._parse_scratch = _native.ParseScratch()
+                self._dedup_scratch = _native.DedupScratch()
             else:
                 log.info("native fastparse unavailable; Python parse path")
 
@@ -333,12 +345,14 @@ class TpuMatcher(Matcher):
         self, lines: Sequence[str], now_unix: Optional[float] = None
     ) -> List[ConsumeLineResult]:
         now = time.time() if now_unix is None else now_unix
-        results = [ConsumeLineResult() for _ in lines]
+        results = LazyResults(len(lines))
 
         # 1. host parse + allowlist exemption (regex_rate_limiter.go:131-172)
         #    — one native C pass when available (banjax_tpu/native), with
-        #    the Python reference path per deferred line and as fallback
-        work: List[Tuple[int, ParsedLine]] = []
+        #    the Python reference path per deferred line and as fallback.
+        #    The gate stays COLUMNAR (workset.py): flag masks, unique-
+        #    string tables, and a per-distinct-(host, ip) allowlist check,
+        #    so no per-line Python objects exist on the hot path.
         pre_encoded = None
         nb = None
         if self._native:
@@ -349,58 +363,9 @@ class TpuMatcher(Matcher):
                 OLD_LINE_CUTOFF_SECONDS, scratch=self._parse_scratch,
             )
         if nb is not None:
-            from banjax_tpu import native
-
-            work_rows: List[int] = []
-            for i in range(nb.n):
-                f = int(nb.flags[i])
-                if f & native.FLAG_DEFER:
-                    p = parse_line(lines[i], now, OLD_LINE_CUTOFF_SECONDS)
-                elif f & native.FLAG_ERROR:
-                    p = ParsedLine(error=True)
-                else:
-                    p = ParsedLine(
-                        old_line=bool(f & native.FLAG_OLD),
-                        timestamp_ns=int(nb.ts_ns[i]),
-                        ip=nb.ip(i),
-                    )
-                    if not p.old_line:
-                        p.host = nb.host(i)
-                        p.rest = nb.rest(i)
-                if p.error:
-                    log.warning("could not parse log line: %r", lines[i])
-                    results[i].error = True
-                    continue
-                if p.old_line:
-                    results[i].old_line = True
-                    continue
-                if self.decision_lists.check_is_allowed(p.host, p.ip):
-                    results[i].exempted = True
-                    continue
-                work.append((i, p))
-                work_rows.append(i)
-            if work:
-                rows = np.asarray(work_rows)
-                deferred = (np.asarray(nb.flags)[rows] & native.FLAG_DEFER) != 0
-                cls_ids = nb.cls_ids[rows]
-                lens = nb.lens[rows]
-                host_eval = (
-                    (np.asarray(nb.flags)[rows] & native.FLAG_HOST_EVAL) != 0
-                )
-                if deferred.any():
-                    # deferred rows were Python-parsed: encode them the
-                    # Python way into the same arrays
-                    d_idx = np.flatnonzero(deferred)
-                    d_cls, d_lens, d_he = encode_for_match(
-                        self.compiled,
-                        [work[int(k)][1].rest for k in d_idx],
-                        self._max_len,
-                    )
-                    cls_ids[d_idx] = d_cls
-                    lens[d_idx] = d_lens
-                    host_eval[d_idx] = d_he
-                pre_encoded = (cls_ids, lens, host_eval)
+            work, pre_encoded = self._native_gate(nb, lines, now, results)
         else:
+            lw = ListWork()
             for i, text in enumerate(lines):
                 p = parse_line(text, now, OLD_LINE_CUTOFF_SECONDS)
                 if p.error:
@@ -413,8 +378,9 @@ class TpuMatcher(Matcher):
                 if self.decision_lists.check_is_allowed(p.host, p.ip):
                     results[i].exempted = True
                     continue
-                work.append((i, p))
-        if not work:
+                lw.append((i, p))
+            work = lw
+        if not len(work):
             return results
 
         # 2a. fully-fused pipeline: match + window apply in ONE device
@@ -434,7 +400,7 @@ class TpuMatcher(Matcher):
                 return results
 
         # 2b. device match bitmap for all matchable lines
-        bits = self._match_bits([p for _, p in work], pre_encoded)
+        bits = self._match_bits(work, pre_encoded)
 
         # 3a. device window pass: fold the whole batch of match events into
         #     the persistent on-device counters in one step, then replay the
@@ -449,9 +415,8 @@ class TpuMatcher(Matcher):
         #     skipped wholesale; matched lines touch only their matched rule
         #     ids, in order — O(matches), not O(lines × rules) Python.
         row_any = bits.any(axis=1)
-        for row, (i, p) in enumerate(work):
-            if not row_any[row]:
-                continue
+        for row in np.flatnonzero(row_any):
+            i, p = work[int(row)]
             ord_arr = self._rule_order_np(p.host)
             try:
                 for idx in ord_arr[bits[row, ord_arr] != 0]:
@@ -467,6 +432,165 @@ class TpuMatcher(Matcher):
     def close(self) -> None:
         """No buffered state: consume_lines is synchronous per batch."""
 
+    def _slots_for_work(self, work) -> Optional[np.ndarray]:
+        """Window-slot ids for a work batch: one LRU decision + one pin
+        per DISTINCT ip (the unique tables the gate already built), then a
+        gather back to row order. Pin/release semantics are unchanged —
+        release_pins deduplicates slot ids either way."""
+        uips, uinv = work.unique_ips()
+        uslots = self.device_windows.slots_for_unique_ips(uips)
+        if uslots is None:
+            return None
+        return uslots[uinv]
+
+    def _native_gate(self, nb, lines, now, results):
+        """Vectorized step 1 over a native ParsedBatch: flag masks, unique
+        ip/host tables (workset.unique_spans), allowlist per DISTINCT
+        (host, ip) with a snapshot-keyed cache, and a columnar NativeWork.
+        Semantics identical to the per-line reference loop; cost is
+        O(distinct strings + matched rows), not O(lines)."""
+        from banjax_tpu import native
+
+        n = nb.n
+        flags = np.asarray(nb.flags[:n])
+        err = (flags & native.FLAG_ERROR) != 0
+        old = (flags & native.FLAG_OLD) != 0
+        ts = nb.ts_ns[:n].astype(np.int64, copy=True)
+
+        defer_map: Dict[int, ParsedLine] = {}
+        for r in np.flatnonzero(flags & native.FLAG_DEFER):
+            r = int(r)
+            p = parse_line(lines[r], now, OLD_LINE_CUTOFF_SECONDS)
+            defer_map[r] = p
+            err[r] = p.error
+            old[r] = p.old_line
+            if not p.error:
+                # Python float()*1e9 can exceed int64 (the columnar array
+                # feeding the device windows); clamp HERE only — replay and
+                # the host window path read the exact Python int from the
+                # deferred ParsedLine itself
+                ts[r] = min(max(p.timestamp_ns, -(2**63)), 2**63 - 1)
+
+        for r in np.flatnonzero(err):
+            log.warning("could not parse log line: %r", lines[int(r)])
+            results[int(r)].error = True
+        for r in np.flatnonzero(old & ~err):
+            results[int(r)].old_line = True
+
+        cand = np.flatnonzero(~err & ~old)
+        if cand.size == 0:
+            return ListWork(), None
+
+        # distinct ip/host string tables over the candidate rows; deferred
+        # rows have no blob spans — patch their strings in via the tables
+        dset = set(defer_map)
+        vrows = np.asarray(
+            [r for r in cand if int(r) not in dset], dtype=np.int64
+        ) if dset else cand
+        text = nb.text()
+        ips_u, ip_inv_v = unique_spans(
+            nb.ip_off[vrows], nb.ip_len[vrows],
+            lambda k: nb.ip(int(vrows[k])),
+            blob=nb.blob, text=text, dedup_scratch=self._dedup_scratch,
+        )
+        hosts_u, host_inv_v = unique_spans(
+            nb.host_off[vrows], nb.host_len[vrows],
+            lambda k: nb.host(int(vrows[k])),
+            blob=nb.blob, text=text, dedup_scratch=self._dedup_scratch,
+        )
+        ip_inv = np.empty(cand.size, dtype=np.int64)
+        host_inv = np.empty(cand.size, dtype=np.int64)
+        if dset:
+            pos_of = {int(r): k for k, r in enumerate(cand)}
+            vmask = np.asarray([int(r) not in dset for r in cand])
+            ip_inv[vmask] = ip_inv_v
+            host_inv[vmask] = host_inv_v
+            iidx = {s: j for j, s in enumerate(ips_u)}
+            hidx = {s: j for j, s in enumerate(hosts_u)}
+            for r, p in defer_map.items():
+                k = pos_of.get(r)
+                if k is None:
+                    continue  # errored/old deferred rows never reach cand
+                j = iidx.get(p.ip)
+                if j is None:
+                    j = len(ips_u)
+                    ips_u.append(p.ip)
+                    iidx[p.ip] = j
+                ip_inv[k] = j
+                j = hidx.get(p.host)
+                if j is None:
+                    j = len(hosts_u)
+                    hosts_u.append(p.host)
+                    hidx[p.host] = j
+                host_inv[k] = j
+        else:
+            ip_inv[:] = ip_inv_v
+            host_inv[:] = host_inv_v
+
+        # allowlist per distinct (host, ip) pair, cached across batches
+        # until the static-lists generation bumps (hot reload) — the CIDR
+        # filters parse the ip string per check, which at per-line rates
+        # costs more than the device match. A decision-lists object
+        # WITHOUT the public counter never caches (fail safe, not stale).
+        gen = getattr(self.decision_lists, "generation", None)
+        if gen is None:
+            self._allow_cache = {}
+            self._allow_cache_snap = None
+        elif gen != self._allow_cache_snap or \
+                len(self._allow_cache) > 500_000:
+            self._allow_cache = {}
+            self._allow_cache_snap = gen
+        n_ip = max(1, len(ips_u))
+        pair = host_inv * n_ip + ip_inv
+        upair, upair_inv = np.unique(pair, return_inverse=True)
+        allowed_u = np.empty(upair.size, dtype=bool)
+        cache = self._allow_cache
+        check = self.decision_lists.check_is_allowed
+        for j, pr in enumerate(upair.tolist()):
+            h = hosts_u[pr // n_ip]
+            ip = ips_u[pr % n_ip]
+            v = cache.get((h, ip))
+            if v is None:
+                v = check(h, ip)
+                cache[(h, ip)] = v
+            allowed_u[j] = v
+        allowed = allowed_u[upair_inv]
+        for k in np.flatnonzero(allowed):
+            results[int(cand[k])].exempted = True
+
+        keep = ~allowed
+        rows = cand[keep]
+        if rows.size == 0:
+            return ListWork(), None
+        work = NativeWork(
+            nb, rows, ips_u, ip_inv[keep], hosts_u, host_inv[keep],
+            ts[rows], defer_map,
+        )
+
+        deferred = (flags[rows] & native.FLAG_DEFER) != 0
+        if rows.size == n:
+            # nothing filtered (the common clean-traffic batch): views,
+            # not 33 MB gather copies of the class matrix
+            cls_ids = nb.cls_ids[:n]
+            lens = nb.lens[:n]
+        else:
+            cls_ids = nb.cls_ids[rows]
+            lens = nb.lens[rows]
+        host_eval = (flags[rows] & native.FLAG_HOST_EVAL) != 0
+        if deferred.any():
+            # deferred rows were Python-parsed: encode them the Python way
+            # into the same arrays
+            d_idx = np.flatnonzero(deferred)
+            d_cls, d_lens, d_he = encode_for_match(
+                self.compiled,
+                [work[int(k)][1].rest for k in d_idx],
+                self._max_len,
+            )
+            cls_ids[d_idx] = d_cls
+            lens[d_idx] = d_lens
+            host_eval[d_idx] = d_he
+        return work, (cls_ids, lens, host_eval)
+
     def _with_window_slots(self, work, split, apply_fn, results) -> None:
         """Shared scaffolding for every device-windows consume path: slot
         allocation with recursive batch split when it refuses, per-line
@@ -479,7 +603,7 @@ class TpuMatcher(Matcher):
         from banjax_tpu.matcher.windows import split_ns
 
         dw = self.device_windows
-        slots = dw.slots_for_ips([p.ip for _, p in work])
+        slots = self._slots_for_work(work)
         if slots is None:
             if len(work) <= 1:
                 log.error(
@@ -498,13 +622,8 @@ class TpuMatcher(Matcher):
             return
         handed_off = False
         try:
-            ts_s, ts_ns = split_ns(
-                np.array([p.timestamp_ns for _, p in work])
-            )
-            host_idx = np.array(
-                [self._host_row.get(p.host, 0) for _, p in work],
-                dtype=np.int32,
-            )
+            ts_s, ts_ns = split_ns(work.ts_array())
+            host_idx = work.host_idx(self._host_row)
             handed_off = True
             apply_fn(work, slots, ts_s, ts_ns, host_idx, results)
         except Exception:
@@ -608,17 +727,12 @@ class TpuMatcher(Matcher):
         from banjax_tpu.matcher.windows import split_ns
 
         dw = self.device_windows
-        slots = dw.slots_for_ips([p.ip for _, p in work])
+        slots = self._slots_for_work(work)
         if slots is None:
             return None
         try:
-            ts_s, ts_ns = split_ns(
-                np.array([p.timestamp_ns for _, p in work])
-            )
-            host_idx = np.array(
-                [self._host_row.get(p.host, 0) for _, p in work],
-                dtype=np.int32,
-            )
+            ts_s, ts_ns = split_ns(work.ts_array())
+            host_idx = work.host_idx(self._host_row)
             pend = self._fw_pipeline.submit(
                 cls_ids, lens, slots, ts_s, ts_ns, host_idx
             )
@@ -802,18 +916,24 @@ class TpuMatcher(Matcher):
 
     # ---- internals ----
 
-    def _match_bits(
-        self, parsed: List[ParsedLine], pre_encoded=None
-    ) -> np.ndarray:
-        """[N, n_rules] uint8 — exact regex-match bitmap for each line.
+    def _match_bits(self, work, pre_encoded=None) -> np.ndarray:
+        """[N, n_rules] uint8 — exact regex-match bitmap for each line of
+        a work batch ((index, line) sequence).
 
         `pre_encoded` = (cls_ids, lens, host_eval) from the native parse
-        pass; when given, the Python re-encode is skipped. The fused
-        prefilter consumes it directly — its plan is built against THIS
-        matcher's byte classes (build_plan byte_classes=...), so the one
-        encode feeds stage 1, stage 2, and the single-stage fallback."""
-        n = len(parsed)
-        rests = [p.rest for p in parsed]
+        pass; when given, the Python re-encode is skipped AND line rests
+        materialize only for host-fallback rows. The fused prefilter
+        consumes it directly — its plan is built against THIS matcher's
+        byte classes (build_plan byte_classes=...), so the one encode
+        feeds stage 1, stage 2, and the single-stage fallback."""
+        n = len(work)
+        rests = (
+            None if pre_encoded is not None
+            else [p.rest for _, p in work]
+        )
+
+        def rest_of(row: int) -> str:
+            return work[row][1].rest if rests is None else rests[row]
 
         if self._prefilter is not None:
             from banjax_tpu.matcher.prefilter import PrefilterOverflow
@@ -874,7 +994,7 @@ class TpuMatcher(Matcher):
 
         # host fallback: whole lines the device can't decide
         for row in np.flatnonzero(host_eval):
-            rest = rests[row]
+            rest = rest_of(int(row))
             for idx, (_, rule) in enumerate(self._entries):
                 if rule.regex.search(rest) is not None:
                     bits[row, idx] = 1
@@ -882,7 +1002,7 @@ class TpuMatcher(Matcher):
         for idx in self._host_rule_idx:
             rule = self._entries[idx][1]
             for row in device_rows:
-                if rule.regex.search(rests[row]) is not None:
+                if rule.regex.search(rest_of(int(row))) is not None:
                     bits[row, idx] = 1
         return bits
 

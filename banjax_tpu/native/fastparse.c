@@ -130,6 +130,61 @@ int64_t fp_split_lines(const uint8_t *blob, int64_t blob_len,
     return n;
 }
 
+/* FNV-1a over one span. */
+static uint64_t span_hash(const uint8_t *p, int64_t n) {
+    uint64_t h = 1469598103934665603ULL;
+    for (int64_t i = 0; i < n; i++) {
+        h ^= p[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+/* Deduplicate (offset, length) spans into first-appearance-ordered ids.
+ *
+ * Replaces the hot path's numpy window-gather + sort-based unique: at 65k
+ * spans the open-addressing probe runs in ~3 ms where the vectorized sort
+ * took ~60 ms, and the output ids are already in first-appearance order
+ * (the order the per-line reference loop assigns window slots in — a
+ * parity surface, see matcher/workset.py).
+ *
+ * ids_out[n]: 0-based unique id per span. first_out[<=n]: the first span
+ * index carrying each id, in id order. table/table_cap: caller-allocated
+ * scratch of int64, table_cap a power of two >= 2n, primed to -1 by this
+ * function. Returns the unique count. */
+int64_t fp_dedup_spans(
+    const uint8_t *blob, int64_t blob_len,
+    const int64_t *offs, const int32_t *lens, int64_t n,
+    int64_t *table, int64_t table_cap,
+    int64_t *ids_out, int64_t *first_out) {
+    (void)blob_len;
+    for (int64_t i = 0; i < table_cap; i++)
+        table[i] = -1;
+    uint64_t mask = (uint64_t)table_cap - 1;
+    int64_t n_uniq = 0;
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *p = blob + offs[i];
+        int64_t len = lens[i];
+        uint64_t slot = span_hash(p, len) & mask;
+        for (;;) {
+            int64_t j = table[slot];
+            if (j < 0) {
+                table[slot] = i;
+                ids_out[i] = n_uniq;
+                first_out[n_uniq] = i;
+                n_uniq++;
+                break;
+            }
+            if (lens[j] == len && memcmp(blob + offs[j], p, (size_t)len) == 0) {
+                ids_out[i] = ids_out[j];
+                break;
+            }
+            slot = (slot + 1) & mask;
+        }
+    }
+    return n_uniq;
+}
+
 /* Parse + encode every line. Outputs are caller-allocated arrays sized
  * [n_lines] (and cls_out sized [n_lines * max_len], zero-filled by the
  * caller or here). Returns 0. */

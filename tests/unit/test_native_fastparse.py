@@ -181,3 +181,75 @@ def test_fast_timestamp_path_bit_identical_to_python_float():
             continue  # python re-parse path: exact by construction
         want = int(float(ts) * 1e9)  # raises -> C wrongly accepted it
         assert int(pb.ts_ns[i]) == want, ts
+
+
+def test_unique_spans_fallback_matches_native():
+    """The scalar fallback of workset.unique_spans (native lib absent)
+    produces the same first-appearance-ordered tables as the C dedup."""
+    import numpy as np
+
+    from banjax_tpu.matcher.workset import unique_spans
+
+    blob = b"zz one two one three two zz one"
+    words = blob.split(b" ")
+    offs, lens, pos = [], [], 0
+    for w in words:
+        offs.append(pos)
+        lens.append(len(w))
+        pos += len(w) + 1
+    offs = np.asarray(offs, dtype=np.int64)
+    lens = np.asarray(lens, dtype=np.int32)
+
+    def decode(k):
+        return blob[int(offs[k]) : int(offs[k]) + int(lens[k])].decode()
+
+    s_fallback, inv_fallback = unique_spans(offs, lens, decode)  # no blob
+    assert s_fallback == ["zz", "one", "two", "three"]
+    assert inv_fallback.tolist() == [0, 1, 2, 1, 3, 2, 0, 1]
+
+    from banjax_tpu import native
+
+    if native.available():
+        s_nat, inv_nat = unique_spans(
+            offs, lens, decode, blob=blob, text=blob.decode()
+        )
+        assert s_nat == s_fallback
+        assert inv_nat.tolist() == inv_fallback.tolist()
+
+
+def test_allowlist_cache_invalidated_on_reload():
+    """The (host, ip) allowlist cache must drop when the static lists are
+    rebuilt (hot reload): an IP removed from the allow list must stop
+    being exempted immediately."""
+    import time as _time
+
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from tests.mock_banner import MockBanner
+
+    yaml_a = """
+regexes_with_rates:
+  - decision: nginx_block
+    rule: insta
+    regex: .*hitme.*
+    interval: 60
+    hits_per_interval: 0
+global_decision_lists:
+  allow:
+    - 7.7.7.7
+"""
+    cfg = config_from_yaml_text(yaml_a)
+    sl = StaticDecisionLists(cfg)
+    m = TpuMatcher(cfg, MockBanner(), sl, RegexRateLimitStates())
+    now = _time.time()
+    line = f"{now:.6f} 7.7.7.7 GET h.com GET /hitme HTTP/1.1 UA"
+    r1 = m.consume_lines([line], now)[0]
+    assert r1.exempted
+
+    # reload: allow list emptied
+    cfg2 = config_from_yaml_text(yaml_a.replace("    - 7.7.7.7\n", ""))
+    sl.update_from_config(cfg2)
+    r2 = m.consume_lines([line], now)[0]
+    assert not r2.exempted and r2.rule_results
