@@ -188,6 +188,23 @@ class StaticDecisionLists:
         fa = self._snapshot.sitewide_sha_inv_list.get(site)
         return fa, fa is not None
 
+    def has_any_allow_entries(self) -> bool:
+        """True when ANY allow source exists (exact or CIDR, global or any
+        site). When False, check_is_allowed is False for every input — the
+        matcher gate skips its per-distinct-(host, ip) loop entirely."""
+        c = self._snapshot
+        if any(d == Decision.ALLOW for d in c.global_decision_lists.values()):
+            return True
+        if Decision.ALLOW in c.global_ip_filters:
+            return True
+        for site_map in c.per_site_decision_lists.values():
+            if any(d == Decision.ALLOW for d in site_map.values()):
+                return True
+        for filters in c.per_site_ip_filters.values():
+            if Decision.ALLOW in filters:
+                return True
+        return False
+
     def check_is_allowed(self, site: str, client_ip: str) -> bool:
         """Allowlist exemption for the log tailer (decision.go:185-216)."""
         c = self._snapshot

@@ -540,26 +540,33 @@ class TpuMatcher(Matcher):
                 len(self._allow_cache) > 500_000:
             self._allow_cache = {}
             self._allow_cache_snap = gen
-        n_ip = max(1, len(ips_u))
-        pair = host_inv * n_ip + ip_inv
-        upair, upair_inv = np.unique(pair, return_inverse=True)
-        allowed_u = np.empty(upair.size, dtype=bool)
-        cache = self._allow_cache
-        check = self.decision_lists.check_is_allowed
-        for j, pr in enumerate(upair.tolist()):
-            h = hosts_u[pr // n_ip]
-            ip = ips_u[pr % n_ip]
-            v = cache.get((h, ip))
-            if v is None:
-                v = check(h, ip)
-                cache[(h, ip)] = v
-            allowed_u[j] = v
-        allowed = allowed_u[upair_inv]
-        for k in np.flatnonzero(allowed):
-            results[int(cand[k])].exempted = True
-
-        keep = ~allowed
-        rows = cand[keep]
+        has_allow = getattr(
+            self.decision_lists, "has_any_allow_entries", lambda: True
+        )()
+        if has_allow:
+            n_ip = max(1, len(ips_u))
+            pair = host_inv * n_ip + ip_inv
+            upair, upair_inv = np.unique(pair, return_inverse=True)
+            allowed_u = np.empty(upair.size, dtype=bool)
+            cache = self._allow_cache
+            check = self.decision_lists.check_is_allowed
+            for j, pr in enumerate(upair.tolist()):
+                h = hosts_u[pr // n_ip]
+                ip = ips_u[pr % n_ip]
+                v = cache.get((h, ip))
+                if v is None:
+                    v = check(h, ip)
+                    cache[(h, ip)] = v
+                allowed_u[j] = v
+            allowed = allowed_u[upair_inv]
+            for k in np.flatnonzero(allowed):
+                results[int(cand[k])].exempted = True
+            keep = ~allowed
+            rows = cand[keep]
+        else:
+            # no allow entries anywhere: nothing can be exempted
+            keep = slice(None)
+            rows = cand
         if rows.size == 0:
             return ListWork(), None
         work = NativeWork(

@@ -109,3 +109,28 @@ global_decision_lists:
     lists = StaticDecisionLists(cfg)
     assert lists.check_global("10.1.2.3") == (Decision.ALLOW, True)
     assert lists.check_global("10.2.2.3") == (Decision.IPTABLES_BLOCK, True)
+
+
+def test_has_any_allow_entries():
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+
+    base = """
+regexes_with_rates: []
+"""
+    sl = StaticDecisionLists(config_from_yaml_text(base))
+    assert not sl.has_any_allow_entries()
+
+    for yaml_frag in (
+        "global_decision_lists:\n  allow:\n    - 1.1.1.1\n",
+        "global_decision_lists:\n  allow:\n    - 10.0.0.0/8\n",
+        "per_site_decision_lists:\n  a.com:\n    allow:\n      - 2.2.2.2\n",
+        "per_site_decision_lists:\n  a.com:\n    allow:\n      - 2.2.0.0/16\n",
+    ):
+        sl2 = StaticDecisionLists(config_from_yaml_text(base + yaml_frag))
+        assert sl2.has_any_allow_entries(), yaml_frag
+    # non-allow lists alone do not count
+    sl3 = StaticDecisionLists(config_from_yaml_text(
+        base + "global_decision_lists:\n  nginx_block:\n    - 3.3.3.3\n"
+    ))
+    assert not sl3.has_any_allow_entries()
