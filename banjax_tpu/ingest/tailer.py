@@ -76,11 +76,12 @@ class LogTailer:
             chunk = f.read()
             if chunk:
                 buffer += chunk
-                batch: List[str] = []
-                while "\n" in buffer:
-                    line, buffer = buffer.split("\n", 1)
-                    if line:
-                        batch.append(line)
+                # one split, not a split-per-line loop: the repeated
+                # "rest of buffer" copy is O(n^2) on a big burst, which is
+                # exactly when the tailer must keep up
+                parts = buffer.split("\n")
+                buffer = parts.pop()
+                batch: List[str] = [line for line in parts if line]
                 if batch:
                     try:
                         self.on_lines(batch)

@@ -1,0 +1,47 @@
+#!/bin/bash
+# Hardware bench session — run the moment the axon tunnel comes up.
+# Ordered so a mid-session tunnel death costs the least: a fast Mosaic
+# parity check (catches a 32-word-alignment lowering reject immediately,
+# with the env fallback to flip), then the headline sections, each
+# persisted to BENCH_partial.json as it completes (bench.py worker).
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. fast compiled-kernel parity at the new 32-word alignment (~2 min)
+timeout 600 python - <<'EOF'
+import time
+t0 = time.time()
+import jax
+print("devices:", jax.devices(), "in", round(time.time() - t0, 1), "s")
+import numpy as np
+from banjax_tpu.matcher import nfa_jax
+from banjax_tpu.matcher.encode import encode_for_match
+from banjax_tpu.matcher.kernels import nfa_match
+from banjax_tpu.matcher.rulec import compile_rules
+import bench
+
+patterns = bench.generate_rules(60)
+compiled = compile_rules(patterns, n_shards="auto")
+prep = nfa_match.prepare(compiled)
+print("wps_p:", prep.wps_p, "shards:", prep.n_shards)
+lines = bench.generate_lines(1024, patterns, seed=5, attack_rate=0.2)
+cls, lens, _ = encode_for_match(compiled, lines, 128)
+got = nfa_match.match_batch_pallas(prep, cls, lens, cols=32)
+params = nfa_jax.match_params(compiled)
+import jax.numpy as jnp
+want = np.asarray(nfa_jax.match_batch(params, jnp.asarray(cls), jnp.asarray(lens), compiled.n_rules))
+assert (got == want).all(), "ALIGN-32 COMPILED PARITY FAILED — set BANJAX_NFA_WORD_ALIGN=128"
+print("align-32 compiled parity OK")
+EOF
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "!!! parity step failed (rc=$rc) — if Mosaic rejected 32-row slabs,"
+  echo "    export BANJAX_NFA_WORD_ALIGN=128 and rerun"
+fi
+
+# 2. headline sections, worker-persisted (single_stage + fused first)
+BENCH_SECTIONS=single_stage,fused BENCH_BUDGET_S=600 timeout 900 python bench.py
+
+# 3. e2e + mesh + ladder
+BENCH_SECTIONS=e2e,mesh BENCH_BUDGET_S=600 timeout 900 python bench.py
+BENCH_SECTIONS=ladder BENCH_BUDGET_S=900 timeout 1200 python bench.py
