@@ -355,7 +355,10 @@ class DeviceWindows:
         self._iv_s = jnp.asarray(iv_s)
         self._iv_ns = jnp.asarray(iv_ns)
 
-        self._slots: "OrderedDict[str, int]" = OrderedDict()  # ip → slot, LRU
+        self._slots: Dict[str, int] = {}  # ip → slot
+        # batch-granular recency per slot (see slots_for_unique_ips)
+        self._last_used = np.zeros(capacity, dtype=np.int64)
+        self._batch_seq = 0
         self._slot_ip: Dict[int, str] = {}
         self._free: List[int] = list(range(capacity - 1, -1, -1))
         self._pending_evict: List[int] = []
@@ -363,7 +366,7 @@ class DeviceWindows:
         # slots handed out by slots_for_ips stay pinned until the matching
         # apply_bitmap consumes them, so a second caller's allocation can
         # never evict a slot whose events are still in flight
-        self._pin_counts: Dict[int, int] = {}
+        self._pin_counts = np.zeros(capacity, dtype=np.int32)
         # spill-on-evict: the host shadow below keeps every counter, so
         # eviction only costs performance (a restore on re-admission), never
         # correctness; this counter surfaces the capacity pressure
@@ -439,17 +442,31 @@ class DeviceWindows:
     ) -> Optional[np.ndarray]:
         """slots_for_ips for a DISTINCT ip list (one slot decision + one
         pin per entry). Callers that already hold a unique table + inverse
-        (the runner's vectorized gate) use this directly and gather."""
+        (the runner's vectorized gate) use this directly and gather.
+
+        Recency is batch-granular: hits record this batch's sequence
+        number in a vectorized `last_used` array (no per-hit order-list
+        churn); eviction scans argmin(last_used) over evictable slots —
+        O(capacity) but evictions are rare by design (auto-grow absorbs
+        distinct-IP pressure first), and which victim is chosen is not a
+        parity surface (spill is lossless either way)."""
         with self._lock:
-            pinned: set = set()
+            self._batch_seq += 1
             out = np.empty(len(ips), dtype=np.int32)
+            misses: List[int] = []
+            get = self._slots.get
             for i, ip in enumerate(ips):
-                slot = self._slots.get(ip)
-                if slot is not None:
-                    self._slots.move_to_end(ip)
-                    pinned.add(slot)
+                slot = get(ip)
+                if slot is None:
+                    misses.append(i)
+                    out[i] = -1
+                else:
                     out[i] = slot
-                    continue
+            if len(misses) < len(ips):
+                hits = out[out >= 0]
+                self._last_used[hits] = self._batch_seq
+            for i in misses:
+                ip = ips[i]
                 if (
                     not self._free
                     and self.auto_grow
@@ -459,52 +476,60 @@ class DeviceWindows:
                         min(self.capacity * 2, self.max_capacity)
                     )
                 if not self._free:
-                    # evict the least-recently-used unpinned slot (skipping
-                    # both this batch's slots and any still in flight from a
-                    # prior slots_for_ips whose apply_bitmap hasn't run)
-                    victim_ip = next(
-                        (
-                            k for k, v in self._slots.items()
-                            if v not in pinned and not self._pin_counts.get(v)
-                        ),
-                        None,
-                    )
-                    if victim_ip is None:
+                    slot = self._evict_one_locked(out)
+                    if slot is None:
                         return None  # every slot pinned
-                    old_slot = self._slots.pop(victim_ip)
-                    self._pending_evict.append(old_slot)
-                    self._free.append(old_slot)
-                    self._slot_ip.pop(old_slot, None)
-                    if self.eviction_count == 0:
-                        import logging
-
-                        hint = (
-                            "auto-size hit its memory-budget ceiling — "
-                            "more HBM or fewer rules would raise it"
-                            if self.auto_grow else
-                            "raise matcher_window_capacity (or set 0 = "
-                            "auto-size) to avoid the churn"
-                        )
-                        logging.getLogger(__name__).warning(
-                            "device-windows capacity (%d slots) exceeded; "
-                            "evicting LRU IP state to the host shadow "
-                            "(restored on re-admission — %s)",
-                            self.capacity, hint,
-                        )
-                    self.eviction_count += 1
-                slot = self._free.pop()
+                else:
+                    slot = self._free.pop()
                 self._slots[ip] = slot
                 self._slot_ip[slot] = ip
+                self._last_used[slot] = self._batch_seq
                 if ip in self._shadow:
                     # previously-evicted IP returns: its counters re-enter
                     # the device in the next maintenance step, BEFORE any
                     # of this batch's events for it are applied
                     self._pending_restore.append((slot, ip))
-                pinned.add(slot)
                 out[i] = slot
-            for slot in out.tolist():
-                self._pin_counts[slot] = self._pin_counts.get(slot, 0) + 1
+            # out holds DISTINCT slots (distinct ips map to distinct
+            # slots), so a vectorized increment pins each exactly once
+            self._pin_counts[out] += 1
             return out
+
+    def _evict_one_locked(self, batch_slots: np.ndarray) -> Optional[int]:
+        """Pick and evict the oldest evictable slot: assigned, not pinned
+        by an in-flight batch, and not already handed to THIS batch
+        (reusing one mid-batch would fold two IPs' counters together)."""
+        used = np.full(self.capacity, np.iinfo(np.int64).max, dtype=np.int64)
+        assigned = list(self._slot_ip)
+        used[assigned] = self._last_used[assigned]
+        used[self._pin_counts > 0] = np.iinfo(np.int64).max
+        mine = batch_slots[batch_slots >= 0]
+        if mine.size:
+            used[mine] = np.iinfo(np.int64).max
+        victim = int(np.argmin(used))
+        if used[victim] == np.iinfo(np.int64).max:
+            return None
+        victim_ip = self._slot_ip.pop(victim)
+        self._slots.pop(victim_ip)
+        self._pending_evict.append(victim)
+        if self.eviction_count == 0:
+            import logging
+
+            hint = (
+                "auto-size hit its memory-budget ceiling — "
+                "more HBM or fewer rules would raise it"
+                if self.auto_grow else
+                "raise matcher_window_capacity (or set 0 = "
+                "auto-size) to avoid the churn"
+            )
+            logging.getLogger(__name__).warning(
+                "device-windows capacity (%d slots) exceeded; "
+                "evicting LRU IP state to the host shadow "
+                "(restored on re-admission — %s)",
+                self.capacity, hint,
+            )
+        self.eviction_count += 1
+        return victim
 
     def _grow_locked(self, new_capacity: int) -> None:
         """Double the slot table in place (auto-size): pad the flat device
@@ -535,6 +560,12 @@ class DeviceWindows:
         self._free = (
             list(range(new_capacity - 1, old_cap - 1, -1)) + self._free
         )
+        self._last_used = np.concatenate(
+            [self._last_used, np.zeros(add, dtype=np.int64)]
+        )
+        self._pin_counts = np.concatenate(
+            [self._pin_counts, np.zeros(add, dtype=np.int32)]
+        )
         self.capacity = new_capacity
         self.grow_count += 1
         import logging
@@ -548,15 +579,10 @@ class DeviceWindows:
     def _release_pins(self, slot_ids) -> None:
         with self._lock:
             # np.unique, not set(tolist()): per-line slot arrays repeat
-            # heavily and the python set build costs more than the whole
-            # unique-slot release loop
-            for slot in np.unique(np.asarray(slot_ids)).tolist():
-                slot = int(slot)
-                left = self._pin_counts.get(slot, 0) - 1
-                if left > 0:
-                    self._pin_counts[slot] = left
-                else:
-                    self._pin_counts.pop(slot, None)
+            # heavily; one vectorized decrement per distinct slot
+            uniq = np.unique(np.asarray(slot_ids, dtype=np.int64))
+            self._pin_counts[uniq] -= 1
+            np.maximum(self._pin_counts, 0, out=self._pin_counts)
 
     @property
     def occupancy(self) -> int:
@@ -573,7 +599,8 @@ class DeviceWindows:
             self._free = list(range(self.capacity - 1, -1, -1))
             self._pending_evict = []
             self._pending_restore = []
-            self._pin_counts.clear()
+            self._pin_counts = np.zeros(self.capacity, dtype=np.int32)
+            self._last_used = np.zeros(self.capacity, dtype=np.int64)
             self._state = self._fresh_state()
 
     def __len__(self) -> int:

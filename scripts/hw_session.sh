@@ -35,8 +35,27 @@ print("align-32 compiled parity OK")
 EOF
 rc=$?
 if [ $rc -ne 0 ]; then
-  echo "!!! parity step failed (rc=$rc) — if Mosaic rejected 32-row slabs,"
-  echo "    export BANJAX_NFA_WORD_ALIGN=128 and rerun"
+  echo "!!! parity step failed (rc=$rc) — retrying the whole session with"
+  echo "    the conservative 128-word alignment"
+  export BANJAX_NFA_WORD_ALIGN=128
+  timeout 600 python - <<'EOF' || exit 1
+import jax, numpy as np, jax.numpy as jnp
+from banjax_tpu.matcher import nfa_jax
+from banjax_tpu.matcher.encode import encode_for_match
+from banjax_tpu.matcher.kernels import nfa_match
+from banjax_tpu.matcher.rulec import compile_rules
+import bench
+patterns = bench.generate_rules(60)
+compiled = compile_rules(patterns, n_shards="auto")
+prep = nfa_match.prepare(compiled)
+lines = bench.generate_lines(1024, patterns, seed=5, attack_rate=0.2)
+cls, lens, _ = encode_for_match(compiled, lines, 128)
+got = nfa_match.match_batch_pallas(prep, cls, lens, cols=32)
+params = nfa_jax.match_params(compiled)
+want = np.asarray(nfa_jax.match_batch(params, jnp.asarray(cls), jnp.asarray(lens), compiled.n_rules))
+assert (got == want).all(), "align-128 parity ALSO failed - investigate before benching"
+print("align-128 compiled parity OK; continuing with the fallback alignment")
+EOF
 fi
 
 # 2. headline sections, worker-persisted (single_stage + fused first)

@@ -332,6 +332,8 @@ def test_auto_grow_absorbs_distinct_ip_pressure():
     dw.capacity = 2
     dw.max_capacity = 4
     dw._free = [1, 0]
+    dw._pin_counts = np.zeros(2, dtype=np.int32)
+    dw._last_used = np.zeros(2, dtype=np.int64)
     dw._state = dw._fresh_state()
     one = np.ones((1, 1), dtype=np.uint8)
     active = np.ones((1, 1), dtype=bool)
