@@ -465,13 +465,17 @@ class FusedWindowsPipeline:
                 )
                 for k in live
             ]
-            # shadow update mirrors _apply_bitmap_inner: key-sorted event
-            # order, last write per (ip, rule) wins; collect order == apply
-            # order, so concurrent chunks can't interleave stale values
+            # shadow update mirrors _apply_bitmap_inner: (line, rule) order
+            # so dict INSERTION order matches the reference's
+            # first-matched-event order (format_states parity); last write
+            # per (ip, rule) is still the chronologically-final state.
+            # Collect order == apply order, so concurrent chunks can't
+            # interleave stale values.
             from collections import OrderedDict
 
+            shorder = np.lexsort((ev_rule[live], ev_line[live]))
             with wnd._lock:
-                for k in live:
+                for k in live[shorder]:
                     ip = wnd._slot_ip.get(int(p.slots[int(ev_line[k])]))
                     if ip is None:
                         continue

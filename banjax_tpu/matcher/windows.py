@@ -699,11 +699,14 @@ class DeviceWindows:
             f_ss = np.asarray(out["start_s"])
             f_sns = np.asarray(out["start_ns"])
             live = np.flatnonzero(rule >= 0)
-            # shadow update: events arrive key-sorted with chronological
-            # ties, so overwriting in array order leaves each (ip, rule) at
-            # its segment-final state — exactly what was written on device.
-            # setdefault keeps first-event insertion order (reference dict).
-            for k in live:
+            # shadow update in (line, rule) order — the reference's
+            # processing order — so dict INSERTION order matches the host
+            # path's first-matched-event order (format_states parity; slot
+            # numbering follows batch appearance, which can differ). Each
+            # (ip, rule)'s last write is still its chronologically-last
+            # event, i.e. the segment-final state written on device.
+            order = np.lexsort((rule[live], line[live]))
+            for k in live[order]:
                 ip = self._slot_ip.get(int(slot_ids[int(line[k])]))
                 if ip is None:  # unreachable while the batch is pinned
                     continue

@@ -276,3 +276,49 @@ def test_native_parse_path_identical_to_python_parse_path():
             states.format_states(),
         ))
     assert outs[0] == outs[1]
+
+
+class TestHostileSpans:
+    """The columnar gate (matcher/workset.py + fp_dedup_spans) must keep
+    byte-identical streams on adversarial span content: NUL bytes, spans
+    past any window width, non-ASCII blobs (which disable the text
+    fast-slice path), and colliding prefixes."""
+
+    def test_nul_bytes_and_long_hosts(self):
+        long_host = "h" * 200 + ".com"
+        almost = "h" * 200 + ".net"  # same 200-char prefix, distinct tail
+        lines = [
+            f"{ts(0):f} 1.2.3.4 GET {long_host} GET /a HTTP/1.1 UA -",
+            f"{ts(0.1):f} 1.2.3.4 GET {almost} GET /a HTTP/1.1 UA -",
+            f"{ts(0.2):f} 2.2.2.2 GET example.com GET /\x00nul HTTP/1.1 UA -",
+            f"{ts(0.3):f} 2.2.2.2 GET example.com GET /\x00nul HTTP/1.1 UA -",
+            f"{ts(0.4):f} 3.3.3\x00 GET example.com GET /x HTTP/1.1 UA -",
+            f"{ts(0.5):f} 3.3.30 GET example.com GET /x HTTP/1.1 UA -",
+        ]
+        assert_identical_consumption(lines)
+
+    def test_non_ascii_blob_disables_text_slicing(self):
+        # one non-ASCII byte anywhere forces the per-span decode path for
+        # the WHOLE batch's unique tables; results must not change
+        lines = [
+            f"{ts(0):f} 1.2.3.4 GET example.com GET /café HTTP/1.1 UA -",
+            f"{ts(0.1):f} 1.2.3.4 GET example.com GET /page HTTP/1.1 UA -",
+            f"{ts(0.2):f} 5.6.7.8 GET example.com GET /page HTTP/1.1 UA -",
+            f"{ts(0.3):f} 5.6.7.8 POST example.com POST /form HTTP/1.1 UA -",
+            f"{ts(0.4):f} 5.6.7.8 POST example.com POST /form HTTP/1.1 UA -",
+        ]
+        assert_identical_consumption(lines)
+
+    def test_generative_hostile_bytes(self):
+        rng = __import__("random").Random(77)
+        ips = ["1.1.1.1", "2.2.2.2", "3.3.3.3", "\x00weird", "ip" * 40]
+        hosts = ["example.com", "per-site.com", "h" * 120, "héhé.com",
+                 "skipme.com"]
+        paths = ["/p", "/blockme", "/x\x00y", "/" + "q" * 90, "/ok"]
+        lines = []
+        for i in range(120):
+            lines.append(
+                f"{ts(i * 0.01):f} {rng.choice(ips)} GET "
+                f"{rng.choice(hosts)} GET {rng.choice(paths)} HTTP/1.1 UA -"
+            )
+        assert_identical_consumption(lines)
