@@ -490,6 +490,22 @@ def _sec_e2e(jax, ctx, backend, deadline, out) -> dict:
     if fw is not None:
         out["e2e_pipeline_fused"] = fw.fused_batches
         out["e2e_pipeline_fallback"] = fw.fallback_batches
+
+    # realistic-traffic variant: heavy IP repetition (2k distinct) — the
+    # default burst above is near-worst-case (every line a fresh IP, the
+    # config4 shape), which stresses the per-distinct-ip host work; real
+    # edges see orders of magnitude more reuse
+    lines_r = [
+        f"{now:.6f} 10.9.{(i % 2048) >> 8}.{i % 256} {r}"
+        for i, r in enumerate(rests)
+    ]
+    m.consume_lines(lines_r, now)
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        m.consume_lines(lines_r, now)
+    out["e2e_repeat_ip_lines_per_sec"] = round(
+        burst * n_batches / (time.perf_counter() - t0), 1
+    )
     return out
 
 

@@ -361,3 +361,91 @@ def test_auto_grow_absorbs_distinct_ip_pressure():
     hit("ip-e", base + 5)
     assert dw.capacity == 4 and dw.eviction_count == 1
     assert len(dw) == 5
+
+
+def test_concurrent_consume_reload_metrics_soak():
+    """Race-detection soak (SURVEY.md §5): consume_lines on one thread,
+    static-list hot reloads (allow-cache invalidation) and metrics
+    snapshots on others. No exceptions, no torn state, and the allowlist
+    flip must take effect on the batch after the reload."""
+    import threading
+    import time as _time
+
+    import yaml as _yaml
+
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from banjax_tpu.obs.stats import MatcherStats  # noqa: F401 — via matcher
+    from tests.mock_banner import MockBanner
+
+    base = {
+        "regexes_with_rates": [
+            {"rule": "hit", "regex": ".*attackpath.*", "interval": 60,
+             "hits_per_interval": 2, "decision": "nginx_block"},
+        ],
+    }
+    cfg = config_from_yaml_text(_yaml.safe_dump(base))
+    cfg.matcher_device_windows = True
+    cfg.matcher_batch_lines = 256
+    sl = StaticDecisionLists(cfg)
+    m = TpuMatcher(cfg, MockBanner(), sl, RegexRateLimitStates())
+    now = _time.time()
+    lines = [
+        f"{now:.6f} 10.1.{i % 16}.{i % 7} GET h.com GET "
+        f"/{'attackpath' if i % 9 == 0 else 'ok'}{i} HTTP/1.1 UA -"
+        for i in range(512)
+    ]
+    errors = []
+    stop = threading.Event()
+
+    def reloader():
+        flip = False
+        while not stop.is_set():
+            try:
+                alt = dict(base)
+                if flip:
+                    alt = {**base, "global_decision_lists": {
+                        "allow": ["10.1.0.0", "10.1.1.1"]}}
+                sl.update_from_config(
+                    config_from_yaml_text(_yaml.safe_dump(alt))
+                )
+                flip = not flip
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            _time.sleep(0.002)
+
+    def metrics():
+        while not stop.is_set():
+            try:
+                m.stats.snapshot(m.device_windows, m)
+                m.device_windows.occupancy
+                len(m.device_windows)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            _time.sleep(0.001)
+
+    threads = [threading.Thread(target=reloader),
+               threading.Thread(target=metrics)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(30):
+            m.consume_lines(lines, now)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors[:3]
+
+    # determinism epilogue: with the allow list pinned ON, the flip must
+    # be visible immediately (generation-keyed cache)
+    sl.update_from_config(config_from_yaml_text(_yaml.safe_dump(
+        {**base, "global_decision_lists": {"allow": ["10.1.2.2"]}}
+    )))
+    r = m.consume_lines(
+        [f"{now:.6f} 10.1.2.2 GET h.com GET /attackpathZ HTTP/1.1 UA -"],
+        now,
+    )[0]
+    assert r.exempted
