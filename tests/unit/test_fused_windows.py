@@ -207,3 +207,44 @@ def test_pipeline_with_eviction_churn():
     )
     assert tpu.device_windows.eviction_count > 0
     assert tpu._fw_pipeline.fused_batches > 0
+
+@pytest.mark.parametrize("seed", [11, 22, 33, 44])
+def test_generative_overflow_interleaving_stress(seed):
+    """Randomized knob combinations chosen to force every fallback edge at
+    once — candidate overflow, pair overflow, event overflow, slot-refusal
+    splits, eviction churn, multi-chunk overlap — across multiple bursts
+    on a shared IP pool, byte-identical to the serial CPU reference."""
+    import random
+
+    rng = random.Random(seed)
+    patterns = [r"GET /attack[0-9]+", r"(?i)scanbot", r"POST /x[a-z]{1,3}",
+                r"/probe\.php"]
+    now = time.time()
+    knobs = dict(
+        matcher_batch_lines=rng.choice([32, 64, 96]),
+        matcher_prefilter_cand_frac=rng.choice([1.0 / 64, 0.1, 1.0]),
+        matcher_window_capacity=rng.choice([0, 8, 16]),
+    )
+    tpu = None
+    y = _rules_yaml(patterns, hits=rng.choice([0, 2, 5]),
+                    interval=rng.choice([5, 60]))
+    cpu, cb = _mk(CpuMatcher, y)
+    tpu, tb = _mk(TpuMatcher, y, matcher_device_windows=True, **knobs)
+    if rng.random() < 0.5:
+        tpu.device_windows.max_events = max(tpu.compiled.n_rules, 16)
+    want, got = [], []
+    for burst in range(3):
+        n = rng.choice([64, 160, 256])
+        lines = _lines(
+            patterns, n, now + burst, attack_rate=rng.choice([0.1, 0.6, 1.0]),
+            n_ips=rng.choice([4, 24, 200]), seed=seed * 10 + burst,
+        )
+        want.extend(cpu.consume_line(l, now + burst) for l in lines)
+        got.extend(tpu.consume_lines(lines, now + burst))
+    assert [_key(a) for a in want] == [_key(b) for b in got]
+    assert cb.bans == tb.bans
+    assert cb.regex_ban_logs == tb.regex_ban_logs
+    # full counter-state parity too (spills restored, no torn fallbacks)
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates as _R
+    assert cpu.rate_limit_states.format_states() == \
+        tpu.device_windows.format_states()
