@@ -141,7 +141,12 @@ class NativeWork:
         the shared table so a slice never allocates (and pins) window
         slots for ips that aren't in it."""
         present, inv = np.unique(self.ip_inv, return_inverse=True)
-        return [self.ips_u[int(j)] for j in present], inv
+        if present.size == len(self.ips_u):
+            # unsliced view (or one covering every table entry): ids are
+            # already compact — skip the per-entry re-list
+            return self.ips_u, self.ip_inv
+        ips_u = self.ips_u
+        return [ips_u[j] for j in present.tolist()], inv
 
     def host_idx(self, host_row: Dict[str, int]) -> np.ndarray:
         tbl = np.asarray(
@@ -216,10 +221,12 @@ def unique_spans(
         if df is not None:
             ids, first = df
             if text is not None:
-                ot, lt = offs, lens
+                # tolist() first: per-item numpy-scalar -> int conversions
+                # cost more than the slices themselves at 65k uniques
+                ot = offs.tolist()
+                lt = lens.tolist()
                 strings = [
-                    text[int(ot[r]) : int(ot[r]) + int(lt[r])]
-                    for r in first
+                    text[ot[r] : ot[r] + lt[r]] for r in first.tolist()
                 ]
             else:
                 strings = [decode(int(r)) for r in first]
