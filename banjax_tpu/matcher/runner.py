@@ -308,6 +308,8 @@ class TpuMatcher(Matcher):
         # whole ruleset per line (regex_rate_limiter.go:175-211 order)
         self._rule_order_cache: Dict[str, np.ndarray] = {}
         self._global_order_arr = np.asarray(self._global_idx, dtype=np.int64)
+        self._rule_pos_cache: Dict[str, Dict[int, int]] = {}
+        self._global_pos = {int(x): k for k, x in enumerate(self._global_idx)}
 
         # fully-fused matcher+windows pipeline: one device dispatch per
         # batch when both the fused prefilter and device windows are on and
@@ -417,9 +419,12 @@ class TpuMatcher(Matcher):
         row_any = bits.any(axis=1)
         for row in np.flatnonzero(row_any):
             i, p = work[int(row)]
-            ord_arr = self._rule_order_np(p.host)
+            pos = self._rule_pos(p.host)
+            ids = np.nonzero(bits[row])[0].tolist()
             try:
-                for idx in ord_arr[bits[row, ord_arr] != 0]:
+                for idx in sorted(
+                    (x for x in ids if x in pos), key=pos.__getitem__
+                ):
                     _, rule = self._entries[idx]
                     results[i].rule_results.append(
                         self._apply_matched_rule(rule, p)
@@ -868,12 +873,17 @@ class TpuMatcher(Matcher):
             row_iter = (r for r in range(len(work)) if row_any[r])
         for row in row_iter:
             i, p = work[row]
-            ord_arr = self._rule_order_np(p.host)
+            # per-site-then-global ORDER via a position dict over the few
+            # matched ids — scanning the full rule-order array per row is
+            # O(n_rules) and dominated the replay at 1k-rule scale
+            pos = self._rule_pos(p.host)
             if sparse is not None:
                 ids = row_ids[row]
-                matched = [x for x in ord_arr if x in ids]
             else:
-                matched = ord_arr[bits[row, ord_arr] != 0]
+                ids = np.nonzero(bits[row])[0].tolist()
+            matched = sorted(
+                (x for x in ids if x in pos), key=pos.__getitem__
+            )
             try:
                 for idx in matched:
                     _, rule = self._entries[idx]
@@ -1057,6 +1067,22 @@ class TpuMatcher(Matcher):
             )
             self._rule_order_cache[host] = arr
         return arr
+
+    def _rule_pos(self, host: str) -> Dict[int, int]:
+        """{rule id -> its position in the host's per-site-then-global
+        order} — the O(matched-ids) replacement for scanning the order
+        array per matched row. Same bounded-cache policy as
+        _rule_order_np (unknown hosts share the global dict)."""
+        if host not in self._per_site_idx:
+            return self._global_pos
+        d = self._rule_pos_cache.get(host)
+        if d is None:
+            d = {
+                int(x): k
+                for k, x in enumerate(self._per_site_idx[host] + self._global_idx)
+            }
+            self._rule_pos_cache[host] = d
+        return d
 
     def _apply_matched_rule(self, rule: RegexWithRate, p: ParsedLine) -> RuleResult:
         """applyRegexToLog after a confirmed regex match
