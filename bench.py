@@ -67,7 +67,7 @@ PARTIAL_PATH = os.path.join(_DIR, "BENCH_partial.json")
 # were measured on the same workload this bench would run.
 WORKLOAD = {"n_rules": N_RULES, "max_len": MAX_LEN, "rule_seed": 7}
 
-SECTIONS = ("single_stage", "fused", "e2e", "mesh", "ladder")
+SECTIONS = ("single_stage", "fused", "e2e", "mesh", "http", "ladder")
 
 # A hung axon init can wedge on the terminal side; killing a client
 # mid-device-op can ALSO wedge the terminal session for later clients
@@ -594,11 +594,44 @@ def _sec_ladder(jax, ctx, backend, deadline, out) -> dict:
     return out
 
 
+def _sec_http(jax, ctx, backend, deadline, out) -> dict:
+    """The reference's OWN headline harnesses (BenchmarkAuthRequest /
+    BenchmarkProtectedPaths, banjax_performance_test.go:18-67) through the
+    real standalone server — recorded as requests/sec."""
+    import io
+    from contextlib import redirect_stdout
+
+    import pytest as _pytest
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = _pytest.main([
+            "tests/perf/test_http_benchmarks.py", "-q", "-s", "-p",
+            "no:cacheprovider",
+        ])
+    for line in buf.getvalue().splitlines():
+        # pytest's progress dots can prefix the payload ('.{"benchmark"...')
+        brace = line.find("{")
+        if brace < 0:
+            continue
+        try:
+            row = json.loads(line[brace:])
+        except json.JSONDecodeError:
+            continue
+        if row.get("benchmark") == "auth_request":
+            out["auth_request_rps"] = row["rps"]
+        elif row.get("benchmark") == "protected_paths":
+            out["protected_paths_rps"] = row["rps"]
+    out["http_bench_rc"] = int(rc)
+    return out
+
+
 _SECTION_FNS = {
     "single_stage": _sec_single_stage,
     "fused": _sec_fused,
     "e2e": _sec_e2e,
     "mesh": _sec_mesh,
+    "http": _sec_http,
     "ladder": _sec_ladder,
 }
 
