@@ -606,8 +606,8 @@ def _sec_http(jax, ctx, backend, deadline, out) -> dict:
     buf = io.StringIO()
     with redirect_stdout(buf):
         rc = _pytest.main([
-            "tests/perf/test_http_benchmarks.py", "-q", "-s", "-p",
-            "no:cacheprovider",
+            os.path.join(_DIR, "tests", "perf", "test_http_benchmarks.py"),
+            "-q", "-s", "-p", "no:cacheprovider",
         ])
     for line in buf.getvalue().splitlines():
         # pytest's progress dots can prefix the payload ('.{"benchmark"...')

@@ -24,3 +24,35 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import shutil  # noqa: E402
+from pathlib import Path  # noqa: E402
+
+import pytest  # noqa: E402
+
+_FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+@pytest.fixture()
+def app_factory(tmp_path, monkeypatch):
+    """Shared standalone-server bootstrap (banjax_base_test.go:32-81
+    setUp): copy a fixture config into a temp cwd, run the real app there,
+    tear it down after. Used by the integration tier AND the perf tier's
+    HTTP benchmark mirrors — one copy, no drift."""
+    from banjax_tpu.cli import BanjaxApp
+
+    apps = []
+    monkeypatch.chdir(tmp_path)
+
+    def start(fixture_name: str) -> "BanjaxApp":
+        config_path = tmp_path / "banjax-config.yaml"
+        shutil.copy(_FIXTURES / fixture_name, config_path)
+        app = BanjaxApp(str(config_path), standalone_testing=True, debug=False)
+        app.start_background()
+        apps.append(app)
+        return app
+
+    yield start
+    for app in apps:
+        app.stop_background()

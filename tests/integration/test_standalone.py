@@ -25,24 +25,8 @@ FIXTURES = Path(__file__).resolve().parent.parent / "fixtures"
 BASE = "http://localhost:8081"
 
 
-@pytest.fixture()
-def app_factory(tmp_path, monkeypatch):
-    """Copies a fixture config into a temp cwd and runs the real app there
-    (banjax_base_test.go:32-81 setUp)."""
-    apps = []
-    monkeypatch.chdir(tmp_path)
-
-    def start(fixture_name: str) -> BanjaxApp:
-        config_path = tmp_path / "banjax-config.yaml"
-        shutil.copy(FIXTURES / fixture_name, config_path)
-        app = BanjaxApp(str(config_path), standalone_testing=True, debug=False)
-        app.start_background()
-        apps.append(app)
-        return app
-
-    yield start
-    for app in apps:
-        app.stop_background()
+# app_factory: shared fixture in tests/conftest.py (also used by the perf
+# tier's HTTP benchmark mirrors)
 
 
 def auth(path="/", ip=None, cookies=None, host=None, method="GET", ua=None):

@@ -10,16 +10,11 @@ asserts a conservative floor so a server-path perf regression fails CI.
 
 import json
 import random
-import shutil
 import time
-from pathlib import Path
 
 import pytest
 import requests
 
-from banjax_tpu.cli import BanjaxApp
-
-FIXTURES = Path(__file__).resolve().parents[1] / "fixtures"
 BASE = "http://localhost:8081"
 
 # requests/sec floors on a 1-core CI box driving via python-requests (the
@@ -30,14 +25,8 @@ PROTECTED_FLOOR_RPS = 150
 
 
 @pytest.fixture()
-def app(tmp_path, monkeypatch):
-    monkeypatch.chdir(tmp_path)
-    config_path = tmp_path / "banjax-config.yaml"
-    shutil.copy(FIXTURES / "banjax-config-test.yaml", config_path)
-    a = BanjaxApp(str(config_path), standalone_testing=True, debug=False)
-    a.start_background()
-    yield a
-    a.stop_background()
+def app(app_factory):
+    return app_factory("banjax-config-test.yaml")
 
 
 def _rand_ip(rng):
