@@ -360,3 +360,60 @@ def test_user_agent_precedence_matrix(app_factory, tmp_path):
     # no per-site rule for AhrefsBot: the IP challenge fires before the
     # global UA block
     assert auth("/ua_ahref_challenged_ip", ip="8.8.8.8", ua=ahrefs).status_code == 429
+
+
+def test_regex_banner_via_tpu_matcher(app_factory, tmp_path):
+    """The same async tailer→ban flow as test_regex_banner_bans_after_delay
+    but with `matcher: tpu` (batched device path, device windows on, XLA
+    backend under CI's CPU) — the full production seam: request → access
+    log → tailer batch → TpuMatcher consume_lines → Banner → dynamic
+    lists → next request challenged."""
+    src = (FIXTURES / "banjax-config-test-regex-banner.yaml").read_text()
+    tpu_fixture = tmp_path / "regex-banner-tpu.yaml"
+    tpu_fixture.write_text(src + (
+        "matcher: tpu\n"
+        "matcher_backend: xla\n"
+        "matcher_batch_lines: 64\n"
+        "matcher_device_windows: true\n"
+        "matcher_window_capacity: 0\n"
+    ))
+    # app_factory copies from FIXTURES; write the variant there-adjacent by
+    # copying into the temp cwd ourselves and starting on it
+    shutil.copy(tpu_fixture, tmp_path / "banjax-config.yaml")
+    app = BanjaxApp(
+        str(tmp_path / "banjax-config.yaml"), standalone_testing=True,
+        debug=False,
+    )
+    app.start_background()
+    try:
+        from banjax_tpu.matcher.runner import TpuMatcher
+
+        _, matcher = app._current_matcher()
+        assert isinstance(matcher, TpuMatcher)
+        assert matcher.device_windows is not None
+
+        ip = "46.46.46.46"
+        r = auth("/challengeme", ip=ip)
+        assert r.status_code == 200  # first request passes; log line is async
+
+        deadline = time.time() + 8
+        challenged = False
+        while time.time() < deadline:
+            r = auth("/", ip=ip)
+            if r.status_code == 429:
+                challenged = True
+                break
+            time.sleep(0.1)
+        assert challenged, "TPU matcher path should have inserted the challenge"
+
+        # allowlist exemption flows through the TPU gate too
+        r = auth("/challengeme", ip="12.12.12.12")
+        assert r.status_code == 200
+        time.sleep(1.0)
+        assert auth("/", ip="12.12.12.12").status_code == 200
+
+        ban_log = Path("banning-log-file.txt").read_text()
+        assert '"trigger":"instant challenge"' in ban_log
+        assert f'"client_ip":"{ip}"' in ban_log
+    finally:
+        app.stop_background()
