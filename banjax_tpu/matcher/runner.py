@@ -306,8 +306,6 @@ class TpuMatcher(Matcher):
         # per-host per-site-then-global rule order as index arrays, so the
         # replay loops touch only matched rules instead of iterating the
         # whole ruleset per line (regex_rate_limiter.go:175-211 order)
-        self._rule_order_cache: Dict[str, np.ndarray] = {}
-        self._global_order_arr = np.asarray(self._global_idx, dtype=np.int64)
         self._rule_pos_cache: Dict[str, Dict[int, int]] = {}
         self._global_pos = {int(x): k for k, x in enumerate(self._global_idx)}
 
@@ -506,16 +504,21 @@ class TpuMatcher(Matcher):
         ip_inv = np.empty(cand.size, dtype=np.int64)
         host_inv = np.empty(cand.size, dtype=np.int64)
         if dset:
-            pos_of = {int(r): k for k, r in enumerate(cand)}
-            vmask = np.asarray([int(r) not in dset for r in cand])
+            # vectorized membership/positions (cand is sorted): a python
+            # per-element loop here would cost O(lines) whenever ANY row
+            # deferred
+            darr = np.fromiter(dset, dtype=np.int64)
+            vmask = ~np.isin(cand, darr)
             ip_inv[vmask] = ip_inv_v
             host_inv[vmask] = host_inv_v
             iidx = {s: j for j, s in enumerate(ips_u)}
             hidx = {s: j for j, s in enumerate(hosts_u)}
-            for r, p in defer_map.items():
-                k = pos_of.get(r)
-                if k is None:
-                    continue  # errored/old deferred rows never reach cand
+            for r in darr.tolist():
+                p = defer_map[r]
+                # position of r in cand, or absent (errored/old defer rows)
+                k = int(np.searchsorted(cand, r))
+                if k >= cand.size or cand[k] != r:
+                    continue
                 j = iidx.get(p.ip)
                 if j is None:
                     j = len(ips_u)
@@ -1051,28 +1054,14 @@ class TpuMatcher(Matcher):
             bits[rows] = out[: len(rows)]
         return bits
 
-    def _rule_order_np(self, host: str) -> np.ndarray:
-        """Per-site-then-global rule ids as an index array.
+    def _rule_pos(self, host: str) -> Dict[int, int]:
+        """{rule id -> its position in the host's per-site-then-global
+        order (regex_rate_limiter.go:175-211)} — O(matched-ids) per row.
 
-        Hosts with no per-site rules share one global array — the host
+        Hosts with no per-site rules share one global dict — the host
         field comes from attacker-controlled log lines, so caching per
         unknown host would be an unbounded-memory hole; the per-site cache
         is bounded by the config's site list."""
-        if host not in self._per_site_idx:
-            return self._global_order_arr
-        arr = self._rule_order_cache.get(host)
-        if arr is None:
-            arr = np.asarray(
-                self._per_site_idx[host] + self._global_idx, dtype=np.int64
-            )
-            self._rule_order_cache[host] = arr
-        return arr
-
-    def _rule_pos(self, host: str) -> Dict[int, int]:
-        """{rule id -> its position in the host's per-site-then-global
-        order} — the O(matched-ids) replacement for scanning the order
-        array per matched row. Same bounded-cache policy as
-        _rule_order_np (unknown hosts share the global dict)."""
         if host not in self._per_site_idx:
             return self._global_pos
         d = self._rule_pos_cache.get(host)
