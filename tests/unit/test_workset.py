@@ -1,0 +1,122 @@
+"""Direct unit coverage for matcher/workset.py — the columnar work-batch
+interface both the native and fallback gates provide (NativeWork/ListWork/
+LazyResults/LazyLine). The differential suite covers these end-to-end; here
+the interface contracts are pinned in isolation."""
+
+import numpy as np
+import pytest
+
+from banjax_tpu import native
+from banjax_tpu.matcher.encode import ParsedLine
+from banjax_tpu.matcher.workset import (
+    LazyLine,
+    LazyResults,
+    ListWork,
+    NativeWork,
+    unique_spans,
+)
+
+
+def _native_batch(lines, max_len=64):
+    b2c = np.zeros(257, dtype=np.int32)
+    return native.parse_encode_batch(lines, b2c, max_len, 2e9, 1e18)
+
+
+@pytest.fixture()
+def nb():
+    if not native.available():
+        pytest.skip("no C compiler")
+    lines = [
+        f"1700000000.{i:06d} 10.0.0.{i % 3} GET h{i % 2}.com GET /p{i} x"
+        for i in range(8)
+    ]
+    return _native_batch(lines)
+
+
+def _work_from(nb, rows=None):
+    rows = np.arange(nb.n, dtype=np.int64) if rows is None else rows
+    text = nb.text()
+    ips_u, ip_inv = unique_spans(
+        nb.ip_off[rows], nb.ip_len[rows], lambda k: nb.ip(int(rows[k])),
+        blob=nb.blob, text=text,
+    )
+    hosts_u, host_inv = unique_spans(
+        nb.host_off[rows], nb.host_len[rows], lambda k: nb.host(int(rows[k])),
+        blob=nb.blob, text=text,
+    )
+    return NativeWork(nb, rows, ips_u, ip_inv, hosts_u, host_inv,
+                      nb.ts_ns[rows].astype(np.int64), {})
+
+
+def test_native_work_rows_and_lazy_rest(nb):
+    w = _work_from(nb)
+    assert len(w) == 8
+    i, p = w[3]
+    assert i == 3
+    assert p.ip == "10.0.0.0" and p.host == "h1.com"
+    assert isinstance(p, LazyLine) and p._rest is None  # not yet decoded
+    assert p.rest.startswith("GET h1.com GET /p3")
+    assert p.error is False and p.old_line is False
+
+
+def test_native_work_slicing_compacts_uniques(nb):
+    w = _work_from(nb)
+    ips, inv = w.unique_ips()
+    assert ips == ["10.0.0.0", "10.0.0.1", "10.0.0.2"]  # first appearance
+    assert inv.tolist() == [0, 1, 2, 0, 1, 2, 0, 1]
+    sl = w[0:2]  # rows 0-1: only two ips present
+    ips2, inv2 = sl.unique_ips()
+    assert ips2 == ["10.0.0.0", "10.0.0.1"]
+    assert inv2.tolist() == [0, 1]
+    # host_idx maps through a host-row table; unknown hosts -> 0
+    hi = sl.host_idx({"h1.com": 5})
+    assert hi.tolist() == [0, 5]
+
+
+def test_native_work_defer_map_overrides(nb):
+    p = ParsedLine(timestamp_ns=123, ip="9.9.9.9", host="d.com", rest="R")
+    w = _work_from(nb)
+    w.defer_map[2] = p
+    i, got = w[2]
+    assert i == 2 and got is p
+
+
+def test_list_work_interface():
+    mk = lambda ip, host, ts: ParsedLine(
+        timestamp_ns=ts, ip=ip, host=host, rest="r"
+    )
+    lw = ListWork([(0, mk("a", "h", 5)), (1, mk("b", "h", 6)),
+                   (2, mk("a", "g", 10**25))])
+    ips, inv = lw.unique_ips()
+    assert ips == ["a", "b"] and inv.tolist() == [0, 1, 0]
+    assert lw.host_idx({"g": 3}).tolist() == [0, 0, 3]
+    ts = lw.ts_array()
+    assert ts.dtype == np.int64
+    assert ts[2] == 2**63 - 1  # out-of-int64 clamps instead of raising
+    sl = lw[1:]
+    assert isinstance(sl, ListWork) and len(sl) == 2
+
+
+def test_lazy_results_materialize_on_access():
+    r = LazyResults(4)
+    assert len(r) == 4
+    r[1].error = True
+    assert r._items[0] is None          # untouched stays unmaterialized
+    assert r[1].error and not r[2].error
+    assert [x.error for x in r] == [False, True, False, False]
+    assert [x.error for x in r[1:3]] == [True, False]
+
+
+def test_unique_spans_fallback_and_native_agree_on_nuls():
+    blob = b"a\x00b a\x00b a\x00c"
+    offs = np.asarray([0, 4, 8], dtype=np.int64)
+    lens = np.asarray([3, 3, 3], dtype=np.int32)
+
+    def dec(k):
+        return blob[int(offs[k]) : int(offs[k]) + int(lens[k])].decode()
+
+    s1, i1 = unique_spans(offs, lens, dec)  # scalar fallback
+    assert s1 == ["a\x00b", "a\x00c"] and i1.tolist() == [0, 0, 1]
+    if native.available():
+        s2, i2 = unique_spans(offs, lens, dec, blob=blob)
+        assert s2 == s1 and i2.tolist() == i1.tolist()
