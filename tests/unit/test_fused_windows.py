@@ -248,3 +248,38 @@ def test_generative_overflow_interleaving_stress(seed):
     from banjax_tpu.decisions.rate_limit import RegexRateLimitStates as _R
     assert cpu.rate_limit_states.format_states() == \
         tpu.device_windows.format_states()
+
+
+def test_jit_program_variants_stay_bounded():
+    """Production sends ever-varying batch sizes and line lengths; the
+    power-of-two bucketing must keep the number of compiled device
+    programs SMALL and convergent — an unbounded jit cache is a slow
+    memory leak and a per-batch recompile stall in the hot path."""
+    import random
+
+    rng = random.Random(3)
+    patterns = [r"GET /at[a-z]+", r"/probe\.php"]
+    y = _rules_yaml(patterns, hits=3)
+    tpu, _ = _mk(TpuMatcher, y, matcher_device_windows=True,
+                 matcher_batch_lines=128)
+    now = time.time()
+    for i in range(30):
+        n = rng.randint(1, 300)
+        # vary line lengths too (pads L_p buckets)
+        tail = "x" * rng.randint(0, 60)
+        lines = [
+            f"{now + i:.6f} 10.3.{k % 7}.1 GET h.com GET /at{k}{tail} "
+            f"HTTP/1.1 UA -"
+            for k in range(n)
+        ]
+        tpu.consume_lines(lines, now + i)
+    fw = tpu._fw_pipeline
+    assert fw is not None
+    counts = {
+        "pipeline_match_programs": len(fw._match_fns),
+        "pipeline_apply_programs": len(fw._apply_fns),
+    }
+    if tpu._prefilter is not None:
+        counts["prefilter_programs"] = len(tpu._prefilter._fns)
+    assert counts["pipeline_match_programs"] > 0  # the soak really compiled
+    assert all(v <= 8 for v in counts.values()), counts
