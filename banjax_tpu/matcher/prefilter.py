@@ -523,13 +523,19 @@ class FusedPrefilter:
         int32 when the partition fits uint8)."""
         B = cls_ids.shape[0]
         block = self._block_for(max(_MIN_BUCKET, B))
-        Bp = max(block, -(-max(1, B) // block) * block)
+        # power-of-two row buckets (block * 2^k), NOT bare block multiples:
+        # production tail chunks vary freely, and every distinct (Bp, L_p)
+        # is a full device-program compile (~30 s of Mosaic on TPU) — the
+        # bucket bounds lifetime variants to ~log2(max_batch / block)
+        Bp = block
+        while Bp < B:
+            Bp <<= 1
         cols = self._cols
         max_len = int(lens.max()) if B else 0
-        L_p = max(cols, min(
-            -(-cls_ids.shape[1] // cols) * cols,
-            -(-max(1, max_len) // max(32, cols)) * max(32, cols),
-        ))
+        Lm = max(32, cols)
+        while Lm < max_len:
+            Lm <<= 1
+        L_p = max(cols, min(-(-cls_ids.shape[1] // cols) * cols, Lm))
         Lc = min(cls_ids.shape[1], L_p)
         if self._pack_input:
             L4 = -(-L_p // 4)
