@@ -34,7 +34,10 @@ FIXTURES = Path(__file__).resolve().parents[1] / "fixtures"
 # per-line recompile or a lost vectorized replay path fails CI. TPU floors
 # apply when the attached backend is really a TPU (bench.py's ladder on
 # hardware): config 1 is the serial CPU reference either way.
-CPU_FLOORS = {1: 14_000, 2: 3_500, 3: 1_200, 4: 900, 5: 800}
+CPU_FLOORS = {1: 7_000, 2: 3_500, 3: 1_200, 4: 900, 5: 800}
+# config1 runs ~40k solo but ~12k at the tail of a full-suite run (300
+# tests of jit-cache/memory pressure in the same process); 7k still fails
+# on any algorithmic regression (a per-line recompile lands it near 100)
 TPU_FLOORS = {1: 14_000, 2: 8_000, 3: 20_000, 4: 5_000, 5: 5_000}
 
 
