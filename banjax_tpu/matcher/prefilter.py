@@ -517,25 +517,48 @@ class FusedPrefilter:
             return self._block if B >= self._block else 128
         return min(self._block, max(1, B))
 
+    def _row_bucket(self, B: int) -> int:
+        """Power-of-two-growth row bucket that _block_for(Bp) always
+        divides (a compiled Mosaic grid floor-divides by the block, so a
+        non-divisible pad would silently skip the tail). Production tail
+        chunks vary freely and every distinct (Bp, L_p) is a full device
+        program compile (~30 s of Mosaic on TPU); the bucket bounds
+        lifetime variants to ~log2(max_batch / block). Pad rows carry
+        lens=0, so the kernel's tile skip makes them near-free."""
+        if self._pallas and not self.interpret:
+            Bp = 128
+            while Bp < B:
+                Bp <<= 1
+            if Bp >= self._block:
+                # once past the configured block, grow FROM it so the
+                # derived block (self._block, possibly a non-pow2 lane
+                # multiple like 384) divides Bp by construction
+                Bp = self._block
+                while Bp < B:
+                    Bp <<= 1
+            return Bp
+        Bp = _MIN_BUCKET
+        while Bp < B:
+            Bp <<= 1
+        return Bp
+
     def _assemble(self, cls_ids: np.ndarray, lens: np.ndarray):
         """→ (combined [Bp, 1 + L4|L_p] int32, Bp, L_p): the one-transfer
         input layout of _match_core (col 0 = lens; class ids packed 4 per
         int32 when the partition fits uint8)."""
         B = cls_ids.shape[0]
-        block = self._block_for(max(_MIN_BUCKET, B))
-        # power-of-two row buckets (block * 2^k), NOT bare block multiples:
-        # production tail chunks vary freely, and every distinct (Bp, L_p)
-        # is a full device-program compile (~30 s of Mosaic on TPU) — the
-        # bucket bounds lifetime variants to ~log2(max_batch / block)
-        Bp = block
-        while Bp < B:
-            Bp <<= 1
+        Bp = self._row_bucket(max(1, B))
+        block = self._block_for(Bp)
+        # L_p variants are already bounded by a CONSTANT: multiples of 32
+        # up to the caller's fixed matcher_max_line_len (<= max_len/32 of
+        # them) — no pow2 rounding, which would scan up to 2x the bytes on
+        # every batch
         cols = self._cols
         max_len = int(lens.max()) if B else 0
-        Lm = max(32, cols)
-        while Lm < max_len:
-            Lm <<= 1
-        L_p = max(cols, min(-(-cls_ids.shape[1] // cols) * cols, Lm))
+        L_p = max(cols, min(
+            -(-cls_ids.shape[1] // cols) * cols,
+            -(-max(1, max_len) // max(32, cols)) * max(32, cols),
+        ))
         Lc = min(cls_ids.shape[1], L_p)
         if self._pack_input:
             L4 = -(-L_p // 4)
