@@ -87,10 +87,30 @@ class BanjaxApp:
         config = self.config_holder.get()
 
         self.regex_states = RegexRateLimitStates()
-        self.failed_challenge_states = FailedChallengeRateLimitStates()
+        self._supervisor = None  # multi-worker serving (httpapi/workers.py)
+        n_http_workers = max(0, config.http_workers)
+        if n_http_workers > 0:
+            from banjax_tpu.native import shm as native_shm
+
+            if native_shm.available():
+                self.failed_challenge_states = native_shm.ShmFailedChallengeStates()
+            else:
+                log.warning(
+                    "http_workers=%d but native shmstate is unavailable "
+                    "(no C compiler?); serving single-process", n_http_workers
+                )
+                n_http_workers = 0
+        self._n_http_workers = n_http_workers
+        if n_http_workers == 0:
+            self.failed_challenge_states = FailedChallengeRateLimitStates()
         self.protected_paths = PasswordProtectedPaths(config)
         self.static_lists = StaticDecisionLists(config)
-        self.dynamic_lists = DynamicDecisionLists()
+        if n_http_workers > 0:
+            from banjax_tpu.httpapi.workers import ReplicatedDynamicLists
+
+            self.dynamic_lists = ReplicatedDynamicLists()
+        else:
+            self.dynamic_lists = DynamicDecisionLists()
 
         # ban log files (banjax.go:124-138)
         self._banning_log_file = open(config.banning_log_file, "a", encoding="utf-8")
@@ -125,7 +145,12 @@ class BanjaxApp:
         gin_log_name = "gin.log" if config.standalone_testing else config.gin_log_file
         self._gin_log_file = None
         if gin_log_name and gin_log_name != "-":
-            self._gin_log_file = open(gin_log_name, "w", encoding="utf-8")
+            # truncate on start (the reference's os.Create), then APPEND:
+            # in multi-worker mode the workers append to the same file, and
+            # a mode-"w" primary would overwrite their lines at its private
+            # offset
+            open(gin_log_name, "w", encoding="utf-8").close()
+            self._gin_log_file = open(gin_log_name, "a", encoding="utf-8")
 
         self._server_log_file = None
         if config.standalone_testing:
@@ -149,6 +174,8 @@ class BanjaxApp:
         self.static_lists.update_from_config(new_config)
         self.dynamic_lists.clear()
         self.protected_paths.update_from_config(new_config)
+        if self._supervisor is not None:
+            self._supervisor.broadcast_reload()
 
     def _current_matcher(self):
         # rebuilt on config change so rules hot-reload (regex_rate_limiter.go:59)
@@ -212,7 +239,23 @@ class BanjaxApp:
         )
 
     async def _serve(self, install_signal_handlers: bool) -> None:
-        runner = await run_http_server(self.server_deps())
+        if self._n_http_workers > 0:
+            import tempfile
+
+            from banjax_tpu.httpapi.workers import PrimarySupervisor
+
+            ctrl_dir = tempfile.mkdtemp(prefix="banjax-ctrl-")
+            self._supervisor = PrimarySupervisor(
+                self, ctrl_dir, self._n_http_workers
+            )
+            self.dynamic_lists.set_broadcast(self._supervisor.control.broadcast)
+            runner = await run_http_server(
+                self.server_deps(), reuse_port=True,
+                unix_path=self._supervisor.primary_http_sock(),
+            )
+            self._supervisor.spawn_workers()
+        else:
+            runner = await run_http_server(self.server_deps())
         self._async_stop = asyncio.Event()
         if install_signal_handlers:
             loop = asyncio.get_running_loop()
@@ -254,8 +297,18 @@ class BanjaxApp:
 
     def shutdown(self) -> None:
         self._stop_event.set()
+        if self._supervisor is not None:
+            self._supervisor.stop()
+            self._supervisor = None
         self.tailer.stop()
         self.metrics.stop()
+        # release the shm table only AFTER the metrics loop is stopped —
+        # a late tick calling len(failed_challenge_states) on a released
+        # mapping would segfault in fc_count
+        fc = self.failed_challenge_states
+        if hasattr(fc, "unlink"):
+            fc.close()
+            fc.unlink()
         if self.kafka_reader:
             self.kafka_reader.stop()
         if self.kafka_writer:

@@ -83,6 +83,10 @@ class ConfigHolder:
         self._config = _load_config(path, restart_time, standalone_testing, debug)
         self.generation = 0  # bumped on every successful reload
 
+    @property
+    def path(self) -> str:
+        return self._path
+
     def get(self) -> Config:
         return self._config
 

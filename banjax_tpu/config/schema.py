@@ -177,6 +177,13 @@ class Config:
     # native C batch parse+encode for the tailer hot path (banjax_tpu/
     # native); auto-disables when no C compiler is present
     matcher_native_parse: bool = True
+    # SO_REUSEPORT worker processes for the HTTP request API
+    # (httpapi/workers.py). 0 = single process, the reference's layout;
+    # N > 0 spawns N workers sharing 127.0.0.1:8081 with the primary,
+    # with the failed-challenge limiter in native shared memory and
+    # side effects forwarded to the primary. Needs a C compiler at
+    # first start (native/shmstate.c); falls back to 0 without one.
+    http_workers: int = 0
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -209,7 +216,7 @@ _SCALAR_KEYS = {
     "matcher_window_capacity": int, "matcher_prefilter": bool,
     "matcher_prefilter_cand_frac": float,
     "matcher_mesh_devices": int, "matcher_mesh_rp": int,
-    "matcher_native_parse": bool,
+    "matcher_native_parse": bool, "http_workers": int,
 }
 
 _DICT_OR_LIST_KEYS = {

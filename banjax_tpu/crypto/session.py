@@ -29,10 +29,25 @@ class SessionCookieError(ValueError):
     pass
 
 
+# the sha256-derived key depends only on the config secret — memoized so
+# the per-response cookie pays one HMAC, not HMAC + SHA256 (single entry:
+# the secret changes only on config reload)
+_derived_key_cache: tuple = ("", b"")
+
+
+def _derived_key(secret_key: str) -> bytes:
+    global _derived_key_cache
+    cached_secret, cached = _derived_key_cache
+    if cached_secret == secret_key:
+        return cached
+    key = hashlib.sha256(secret_key.encode()).digest()
+    _derived_key_cache = (secret_key, key)
+    return key
+
+
 def _session_cookie_hmac(secret_key: str, expire_time_unix: int, client_ip: str, id_value: int) -> bytes:
     """session_cookie.go:40-55."""
-    derived_key = hashlib.sha256(secret_key.encode()).digest()
-    mac = hmac_mod.new(derived_key, digestmod=hashlib.sha1)
+    mac = hmac_mod.new(_derived_key(secret_key), digestmod=hashlib.sha1)
     mac.update(struct.pack(">Q", expire_time_unix & 0xFFFFFFFFFFFFFFFF))
     mac.update(client_ip.encode())
     mac.update(struct.pack(">I", id_value & 0xFFFFFFFF))

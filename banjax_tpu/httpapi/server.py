@@ -19,9 +19,11 @@ itself, and these routes:
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import json
 import logging
+import os
 import time
 import traceback
 from typing import Optional, TextIO
@@ -66,6 +68,20 @@ class ServerDeps:
     server_log_file: Optional[TextIO] = None  # standalone: fake nginx log
 
 
+_STANDALONE_KEY = "banjax_standalone_hdrs"
+
+
+def _hdr(request: web.Request, name: str) -> str:
+    """Read an X-* header, honoring the standalone middleware's injected
+    values (kept in the request's state dict — cheaper than cloning the
+    request per hit, which the reference does by mutating the shared
+    header map in place, http_server.go:137-169)."""
+    ov = request.get(_STANDALONE_KEY)
+    if ov is not None and name in ov:
+        return ov[name]
+    return request.headers.get(name, "")
+
+
 def _request_info(request: web.Request) -> RequestInfo:
     # gin reads cookies through url.QueryUnescape (c.Cookie); a value whose
     # unescape fails is treated as an absent cookie
@@ -76,13 +92,51 @@ def _request_info(request: web.Request) -> RequestInfo:
         except ValueError:
             continue
     return RequestInfo(
-        client_ip=request.headers.get("X-Client-IP", ""),
-        requested_host=request.headers.get("X-Requested-Host", ""),
-        requested_path=request.headers.get("X-Requested-Path", ""),
-        client_user_agent=request.headers.get("X-Client-User-Agent", ""),
+        client_ip=_hdr(request, "X-Client-IP"),
+        requested_host=_hdr(request, "X-Requested-Host"),
+        requested_path=_hdr(request, "X-Requested-Path"),
+        client_user_agent=_hdr(request, "X-Client-User-Agent"),
         method=request.method,
         cookies=cookies,
     )
+
+
+class CoalescedLog:
+    """Per-request log lines without a per-request flush.
+
+    Lines accumulate in a Python list; a single delayed callback (50 ms)
+    writes the batch with ONE os.write on the underlying fd, so a 1k-rps
+    burst pays ~20 syscalls/sec instead of 1k.  Bypassing the TextIO
+    buffer matters in multi-worker mode: several processes append to the
+    same file, and a block-buffer flush could split a line mid-byte —
+    os.write(O_APPEND) emits whole lines atomically.  Consumers (the
+    standalone tailer, integration tests) all poll with retry budgets far
+    above 50 ms; shutdown replays any tail through flush()."""
+
+    __slots__ = ("_f", "_lines", "_pending", "delay")
+
+    def __init__(self, f: TextIO, delay: float = 0.05):
+        self._f = f
+        self._lines: list = []
+        self._pending = False
+        self.delay = delay
+
+    def write(self, s: str) -> None:
+        self._lines.append(s)
+        if not self._pending:
+            self._pending = True
+            asyncio.get_running_loop().call_later(self.delay, self._flush)
+
+    def _flush(self) -> None:
+        self._pending = False
+        if not self._lines:
+            return
+        data = "".join(self._lines).encode("utf-8", "surrogatepass")
+        self._lines.clear()
+        try:
+            os.write(self._f.fileno(), data)
+        except (OSError, ValueError):
+            pass  # closed during shutdown
 
 
 def _to_web_response(resp: Response) -> web.Response:
@@ -101,13 +155,24 @@ def _to_web_response(resp: Response) -> web.Response:
     return out
 
 
-def build_app(deps: ServerDeps) -> web.Application:
+def build_app(deps: ServerDeps,
+              worker_proxy_sock: Optional[str] = None) -> web.Application:
+    """Build the application.  With `worker_proxy_sock` set (multi-worker
+    mode, httpapi/workers.py) the primary-owned cold routes are registered
+    as reverse proxies to the primary's unix HTTP socket instead of local
+    handlers — a worker's replicas are authoritative only for the
+    /auth_request hot path."""
     middlewares = []
 
     config0 = deps.config_holder.get()
 
+    coalesced_logs: list = []
+
     # --- access log middleware (http_server.go:65-95) ---
     if deps.gin_log_file is not None:
+        gin_log = CoalescedLog(deps.gin_log_file)
+        coalesced_logs.append(gin_log)
+
         @web.middleware
         async def access_log_middleware(request: web.Request, handler):
             start = time.monotonic()
@@ -115,16 +180,15 @@ def build_app(deps: ServerDeps) -> web.Application:
             latency_us = int((time.monotonic() - start) * 1e6)
             line = {
                 "Time": time.strftime("%a, %d %b %Y %H:%M:%S %Z"),
-                "ClientIp": request.headers.get("X-Client-IP", ""),
-                "ClientReqHost": request.headers.get("X-Requested-Host", ""),
-                "ClientReqPath": request.headers.get("X-Requested-Path", ""),
+                "ClientIp": _hdr(request, "X-Client-IP"),
+                "ClientReqHost": _hdr(request, "X-Requested-Host"),
+                "ClientReqPath": _hdr(request, "X-Requested-Path"),
                 "Method": request.method,
                 "Path": request.path,
                 "Status": response.status,
                 "Latency": latency_us,
             }
-            deps.gin_log_file.write(json.dumps(line) + "\n")
-            deps.gin_log_file.flush()
+            gin_log.write(json.dumps(line) + "\n")
             return response
 
         middlewares.append(access_log_middleware)
@@ -152,34 +216,43 @@ def build_app(deps: ServerDeps) -> web.Application:
     if config0.standalone_testing:
         log.info("!!! standalone-testing mode enabled. adding some X- headers here")
 
+        server_log = (
+            CoalescedLog(deps.server_log_file)
+            if deps.server_log_file is not None else None
+        )
+        if server_log is not None:
+            coalesced_logs.append(server_log)
+
         @web.middleware
         async def standalone_middleware(request: web.Request, handler):
-            headers = request.headers.copy()
-            if not headers.get("X-Client-IP"):
-                peer = request.remote or "127.0.0.1"
-                headers["X-Client-IP"] = peer
-            headers["X-Requested-Host"] = request.host
-            headers["X-Requested-Path"] = request.query.get("path", "")
-            if not headers.get("X-Client-User-Agent"):
-                headers["X-Client-User-Agent"] = "mozilla"
-            request = request.clone(headers=headers)
+            # injected values ride the request's state dict (read back via
+            # _hdr) — same effect as the reference's in-place header-map
+            # mutation, without a per-request clone of the request object
+            hdrs = request.headers
+            client_ip = hdrs.get("X-Client-IP") or request.remote or "127.0.0.1"
+            request[_STANDALONE_KEY] = {
+                "X-Client-IP": client_ip,
+                "X-Requested-Host": request.host,
+                "X-Requested-Path": request.query.get("path", ""),
+                "X-Client-User-Agent": hdrs.get("X-Client-User-Agent")
+                or "mozilla",
+            }
 
             # write the fake nginx banjax_format line so the log tailer has
             # input: '$msec $remote_addr $request_method $host $request $ua'
-            if deps.server_log_file is not None:
-                deps.server_log_file.write(
+            if server_log is not None:
+                server_log.write(
                     "%f %s %s %s %s %s HTTP/1.1 %s\n"
                     % (
                         float(int(time.time())),
-                        request.headers.get("X-Client-IP", ""),
+                        client_ip,
                         request.method,
                         request.host,
                         request.method,
                         request.query.get("path", ""),
-                        request.headers.get("User-Agent", ""),
+                        hdrs.get("User-Agent", ""),
                     )
                 )
-                deps.server_log_file.flush()
             return await handler(request)
 
         # outermost, so the injected X-* headers are visible to the access
@@ -187,6 +260,14 @@ def build_app(deps: ServerDeps) -> web.Application:
         middlewares.insert(0, standalone_middleware)
 
     app = web.Application(middlewares=middlewares)
+
+    if coalesced_logs:
+        # drain any coalesced log tail when the server shuts down
+        async def _drain_logs(app_):
+            for lg in coalesced_logs:
+                lg._flush()
+
+        app.on_cleanup.append(_drain_logs)
 
     # ---------------- routes ----------------
 
@@ -342,12 +423,17 @@ def build_app(deps: ServerDeps) -> web.Application:
 
     app.router.add_route("*", "/auth_request", auth_request)
     app.router.add_get("/info", info)
-    app.router.add_get("/decision_lists", decision_lists_route)
-    app.router.add_get("/rate_limit_states", rate_limit_states_route)
-    app.router.add_get("/is_banned", is_banned)
-    app.router.add_get("/ipset/list", ipset_list_route)
-    app.router.add_get("/banned", banned_route)
-    app.router.add_post("/unban", unban)
+    if worker_proxy_sock is None:
+        app.router.add_get("/decision_lists", decision_lists_route)
+        app.router.add_get("/rate_limit_states", rate_limit_states_route)
+        app.router.add_get("/is_banned", is_banned)
+        app.router.add_get("/ipset/list", ipset_list_route)
+        app.router.add_get("/banned", banned_route)
+        app.router.add_post("/unban", unban)
+    else:
+        from banjax_tpu.httpapi.workers import install_proxy_routes
+
+        install_proxy_routes(app, worker_proxy_sock)
 
     if config0.standalone_testing:
         async def favicon(request: web.Request) -> web.Response:
@@ -437,12 +523,24 @@ def _register_profile_routes(app: web.Application) -> None:
     app.router.add_get("/debug/jax/trace", jax_trace)
 
 
-async def run_http_server(deps: ServerDeps) -> web.AppRunner:
-    """Start the server; returns the runner for clean shutdown."""
-    app = build_app(deps)
+async def run_http_server(
+    deps: ServerDeps,
+    reuse_port: bool = False,
+    unix_path: Optional[str] = None,
+    worker_proxy_sock: Optional[str] = None,
+) -> web.AppRunner:
+    """Start the server; returns the runner for clean shutdown.
+
+    Multi-worker mode (httpapi/workers.py): every process passes
+    `reuse_port=True` so the kernel load-balances 127.0.0.1:8081 across
+    them; the primary also passes `unix_path` (its cold-route listener for
+    worker proxies) and workers pass `worker_proxy_sock`."""
+    app = build_app(deps, worker_proxy_sock=worker_proxy_sock)
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, LISTEN_HOST, LISTEN_PORT)
+    site = web.TCPSite(runner, LISTEN_HOST, LISTEN_PORT, reuse_port=reuse_port)
     await site.start()
+    if unix_path is not None:
+        await web.UnixSite(runner, unix_path).start()
     log.info("http server listening on %s:%s", LISTEN_HOST, LISTEN_PORT)
     return runner

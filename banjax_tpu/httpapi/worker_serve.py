@@ -1,0 +1,136 @@
+"""HTTP worker process entrypoint (`python -m banjax_tpu.httpapi.worker_serve`).
+
+One of N SO_REUSEPORT processes serving the /auth_request hot path (see
+httpapi/workers.py for the architecture).  A worker builds ONLY the
+host-side request state — config, static lists, a dynamic-lists replica,
+the shared-memory failed-challenge table, a forwarding banner — and never
+imports jax: the matcher pipeline, ingest, kafka, ipset, and metrics all
+live in the primary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+import sys
+from typing import Optional
+
+from banjax_tpu.config.holder import ConfigHolder
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.httpapi.server import ServerDeps, run_http_server
+from banjax_tpu.httpapi.workers import (
+    PRIMARY_HTTP_SOCK,
+    RemoteBanner,
+    WorkerControl,
+)
+from banjax_tpu.ingest import reports
+from banjax_tpu.native.shm import ShmFailedChallengeStates
+
+log = logging.getLogger(__name__)
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(prog="banjax-tpu-worker", prefix_chars="-")
+    parser.add_argument("-config-file", dest="config_file", required=True)
+    parser.add_argument("-ctrl-dir", dest="ctrl_dir", required=True)
+    parser.add_argument("-index", dest="index", type=int, required=True)
+    parser.add_argument("-shm-name", dest="shm_name", required=True)
+    parser.add_argument("-standalone-testing", dest="standalone_testing",
+                        action="store_true")
+    parser.add_argument("-debug", dest="debug", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format=f"%(asctime)s worker-{args.index} %(name)s %(levelname)s %(message)s",
+    )
+
+    config_holder = ConfigHolder(
+        args.config_file, args.standalone_testing, args.debug
+    )
+    config = config_holder.get()
+
+    static_lists = StaticDecisionLists(config)
+    protected_paths = PasswordProtectedPaths(config)
+    replica = DynamicDecisionLists()
+    failed_challenge_states = ShmFailedChallengeStates(name=args.shm_name)
+
+    def on_reload() -> None:
+        log.info("worker %d: reloading config", args.index)
+        try:
+            config_holder.reload()
+        except Exception as e:  # noqa: BLE001 — keep serving on a bad reload
+            log.error("worker reload failed: %s", e)
+            return
+        new_config = config_holder.get()
+        static_lists.update_from_config(new_config)
+        protected_paths.update_from_config(new_config)
+        # the replica is cleared by the primary's dyn_clear broadcast
+
+    control = WorkerControl(args.ctrl_dir, args.index, replica, on_reload)
+    banner = RemoteBanner(control, replica)
+
+    # kafka reports from this worker's request path ride the control socket
+    reports.set_forwarder(
+        lambda data: control.send({"op": "kafka", "data": data.decode("utf-8")})
+    )
+
+    gin_log_file = None
+    gin_log_name = "gin.log" if config.standalone_testing else config.gin_log_file
+    if gin_log_name and gin_log_name != "-":
+        # O_APPEND: every worker and the primary append whole lines to the
+        # same access log
+        gin_log_file = open(gin_log_name, "a", encoding="utf-8")
+
+    server_log_file = None
+    if config.standalone_testing:
+        server_log_file = open(config.server_log_file, "a", encoding="utf-8")
+
+    deps = ServerDeps(
+        config_holder=config_holder,
+        static_lists=static_lists,
+        dynamic_lists=replica,
+        protected_paths=protected_paths,
+        regex_states=RegexRateLimitStates(),  # primary-owned; route proxied
+        failed_challenge_states=failed_challenge_states,
+        banner=banner,
+        gin_log_file=gin_log_file,
+        server_log_file=server_log_file,
+    )
+    primary_sock = os.path.join(args.ctrl_dir, PRIMARY_HTTP_SOCK)
+
+    async def serve() -> None:
+        runner = await run_http_server(
+            deps, reuse_port=True, worker_proxy_sock=primary_sock
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        log.info("worker %d serving", args.index)
+        await stop.wait()
+        await runner.cleanup()
+
+    try:
+        asyncio.run(serve())
+    finally:
+        control.stop()
+        replica.close()
+        failed_challenge_states.close()
+        for f in (gin_log_file, server_log_file):
+            if f is not None:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

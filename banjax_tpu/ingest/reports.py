@@ -27,6 +27,17 @@ log = logging.getLogger(__name__)
 # module-level like the reference's global messageChan (kafka.go:349-350)
 _message_queue: "queue.Queue[bytes]" = queue.Queue(maxsize=256)
 
+# in an HTTP worker process (httpapi/worker_serve.py) there is no kafka
+# writer draining the queue: reports are forwarded to the primary instead
+_forwarder = None
+
+
+def set_forwarder(fn) -> None:
+    """Route report bytes through `fn` instead of the local queue (worker
+    processes forward to the primary's control socket)."""
+    global _forwarder
+    _forwarder = fn
+
 
 def get_message_queue() -> "queue.Queue[bytes]":
     return _message_queue
@@ -34,6 +45,9 @@ def get_message_queue() -> "queue.Queue[bytes]":
 
 def _send_bytes(data: bytes) -> None:
     """Non-blocking send; drop when the writer isn't draining (kafka.go:334-346)."""
+    if _forwarder is not None:
+        _forwarder(data)
+        return
     try:
         _message_queue.put_nowait(data)
     except queue.Full:

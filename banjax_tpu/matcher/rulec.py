@@ -793,12 +793,28 @@ def compile_rules(patterns: Sequence[str], n_shards=1) -> CompiledRules:
 # slices at multiples of W, [W, 8] mask rows, the [W, block] state) still
 # satisfies — is the default; BANJAX_NFA_WORD_ALIGN=128 restores the old
 # conservative padding if a Mosaic version rejects 32-row slabs.
-KERNEL_WORD_ALIGN = int(os.environ.get("BANJAX_NFA_WORD_ALIGN", "32") or 32)
-if KERNEL_WORD_ALIGN not in (32, 64, 128):
-    raise ValueError(
-        f"BANJAX_NFA_WORD_ALIGN={KERNEL_WORD_ALIGN!r}: must be 32, 64, or "
-        "128 (multiples of the int8 sublane tile up to the lane width)"
-    )
+def _parse_word_align(raw: "str | None") -> int:
+    # Invalid values fall back to the default with a warning rather than
+    # raising at import time (a typo'd env var must not take down the server).
+    try:
+        val = int(raw or 32)
+    except (TypeError, ValueError):
+        val = -1
+    if val not in (32, 64, 128):
+        if raw not in (None, "", "32"):
+            import warnings
+
+            warnings.warn(
+                f"BANJAX_NFA_WORD_ALIGN={raw!r}: must be 32, 64, or 128 "
+                "(multiples of the int8 sublane tile up to the lane width); "
+                "falling back to 32",
+                stacklevel=2,
+            )
+        val = 32
+    return val
+
+
+KERNEL_WORD_ALIGN = _parse_word_align(os.environ.get("BANJAX_NFA_WORD_ALIGN"))
 _KERNEL_MAX_WPS = 512      # the kernel's per-shard VMEM comfort budget
 
 

@@ -507,7 +507,9 @@ class TpuMatcher(Matcher):
             # vectorized membership/positions (cand is sorted): a python
             # per-element loop here would cost O(lines) whenever ANY row
             # deferred
-            darr = np.fromiter(dset, dtype=np.int64)
+            # sorted so deferred rows append to the unique tables in LINE
+            # order (first-appearance contract), not set hash order
+            darr = np.sort(np.fromiter(dset, dtype=np.int64))
             vmask = ~np.isin(cand, darr)
             ip_inv[vmask] = ip_inv_v
             host_inv[vmask] = host_inv_v

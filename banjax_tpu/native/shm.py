@@ -1,0 +1,248 @@
+"""Loader + wrapper for the shared-memory rate-limit table (shmstate.c).
+
+`ShmFailedChallengeStates` is a drop-in for
+`banjax_tpu.decisions.rate_limit.FailedChallengeRateLimitStates` whose
+state lives in a POSIX shared-memory segment, so N SO_REUSEPORT worker
+processes count an IP's failed challenges exactly once — the
+multi-process twin of the reference's mutex-guarded map
+(/root/reference/internal/rate_limit.go:105-156).
+
+Compiled with the same on-demand ctypes pattern as fastparse (see
+native/__init__.py); unavailable compiler => callers keep the
+single-process Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sysconfig
+import tempfile
+import threading
+import time
+from multiprocessing import shared_memory
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from banjax_tpu.decisions.rate_limit import RateLimitMatchType, RateLimitResult
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "shmstate.c")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+KEY_MAX = 104
+SLOT_BYTES = 128
+HEADER_BYTES = 128
+
+MATCH_MASK = 0x0F
+EXCEEDED_BIT = 0x10
+DROPPED_BIT = 0x100
+
+
+def _so_path() -> str:
+    plat = sysconfig.get_platform().replace("-", "_")
+    cache_dir = os.environ.get(
+        "BANJAX_NATIVE_CACHE", os.path.join(tempfile.gettempdir(), "banjax-native")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    src_mtime = int(os.stat(_SRC).st_mtime)
+    return os.path.join(cache_dir, f"shmstate_{plat}_{src_mtime}.so")
+
+
+def _compile(so: str) -> bool:
+    for cc in (os.environ.get("CC"), "cc", "gcc", "clang"):
+        if not cc:
+            continue
+        cmd = [cc, "-O3", "-shared", "-fPIC", "-o", so, _SRC]
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            return True
+        log.debug("shmstate compile with %s failed: %s", cc, r.stderr[-500:])
+    return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("BANJAX_NO_NATIVE"):
+            return None
+        so = _so_path()
+        if not os.path.exists(so) and not _compile(so):
+            log.info("no C compiler; shared-memory rate-limit state unavailable")
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            log.warning("could not load %s: %s", so, e)
+            return None
+        vp = ctypes.c_void_p
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.fc_init.restype = ctypes.c_int64
+        lib.fc_init.argtypes = [vp, ctypes.c_int64]
+        lib.fc_check.restype = ctypes.c_int64
+        lib.fc_check.argtypes = [vp]
+        lib.fc_apply.restype = ctypes.c_int32
+        lib.fc_apply.argtypes = [
+            vp, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32, i32p,
+        ]
+        lib.fc_count.restype = ctypes.c_int64
+        lib.fc_count.argtypes = [vp]
+        lib.fc_dropped.restype = ctypes.c_int64
+        lib.fc_dropped.argtypes = [vp]
+        lib.fc_snapshot.restype = ctypes.c_int64
+        lib.fc_snapshot.argtypes = [
+            vp, ctypes.c_char_p, i32p, i32p, i64p, ctypes.c_int64,
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class ShmFailedChallengeStates:
+    """Failed-challenge rate limiter over a shared-memory table.
+
+    Same `apply(ip, config) -> RateLimitResult` / `__len__` /
+    `format_states()` interface as the Python class; iteration order of
+    format_states is table order (hash order), not insertion order — the
+    route's output contract does not pin an order.
+    """
+
+    def __init__(self, name: Optional[str] = None, capacity: int = 65536):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native shmstate unavailable (no C compiler?)")
+        self._lib = lib
+        self.capacity = capacity
+        size = HEADER_BYTES + capacity * SLOT_BYTES
+        if name is None:
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            self.owner = True
+            self._map_base()
+            if lib.fc_init(self._base_ptr, capacity) != 0:
+                raise ValueError(f"capacity {capacity} not a power of two")
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            self.owner = False
+            # Python ≤3.12: attaching registers the segment with THIS
+            # process's resource tracker, which unlinks it when this
+            # process exits — yanking the table out from under the primary
+            # and the other workers.  Only the creator may unlink.
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(self._shm._name, "shared_memory")
+            except Exception:  # noqa: BLE001 — tracker internals shifted
+                pass
+            self._map_base()
+            cap = lib.fc_check(self._base_ptr)
+            if cap < 0:
+                raise RuntimeError(f"shm segment {name} is not an fc table")
+            self.capacity = int(cap)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    def _map_base(self) -> None:
+        # extract the raw mapping address once; the transient from_buffer
+        # export is dropped immediately so close() can release the mmap.
+        # The address stays valid while self._shm is open (object lifetime).
+        tmp = (ctypes.c_char * 1).from_buffer(self._shm.buf)
+        self._base_ptr = ctypes.c_void_p(ctypes.addressof(tmp))
+        del tmp
+
+    def _base(self) -> ctypes.c_void_p:
+        return self._base_ptr
+
+    def apply(self, ip: str, config) -> RateLimitResult:
+        # a zero-length key would mark the slot "empty" in the C table, so
+        # an empty client IP maps to a one-NUL sentinel (no real IP
+        # collides with it); the Python limiter counts "" normally and so
+        # must we
+        key = ip.encode("utf-8", "surrogatepass")[:KEY_MAX] or b"\x00"
+        interval_ns = (
+            config.too_many_failed_challenges_interval_seconds * 1_000_000_000
+        )
+        threshold = config.too_many_failed_challenges_threshold
+        base = self._base()
+        if base is None:  # closed (shutdown); NULL would segfault in C
+            return RateLimitResult()
+        hits = ctypes.c_int32(0)
+        rc = self._lib.fc_apply(
+            base, key, len(key), time.time_ns(), interval_ns,
+            threshold, ctypes.byref(hits),
+        )
+        return RateLimitResult(
+            match_type=RateLimitMatchType(rc & MATCH_MASK),
+            exceeded=bool(rc & EXCEEDED_BIT),
+        )
+
+    def __len__(self) -> int:
+        base = self._base()
+        return int(self._lib.fc_count(base)) if base is not None else 0
+
+    @property
+    def dropped(self) -> int:
+        base = self._base()
+        return int(self._lib.fc_dropped(base)) if base is not None else 0
+
+    def _entries(self) -> List[Tuple[str, int, int]]:
+        if self._base() is None:
+            return []
+        cap = self.capacity
+        blob = ctypes.create_string_buffer(cap * KEY_MAX)
+        key_lens = np.zeros(cap, dtype=np.int32)
+        hits = np.zeros(cap, dtype=np.int32)
+        starts = np.zeros(cap, dtype=np.int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        n = self._lib.fc_snapshot(
+            self._base(), blob, key_lens.ctypes.data_as(i32p),
+            hits.ctypes.data_as(i32p), starts.ctypes.data_as(i64p), cap,
+        )
+        out = []
+        for i in range(int(n)):
+            raw = blob.raw[i * KEY_MAX : i * KEY_MAX + int(key_lens[i])]
+            if raw == b"\x00":
+                raw = b""  # the empty-ip sentinel (see apply)
+            out.append(
+                (raw.decode("utf-8", "surrogatepass"), int(hits[i]), int(starts[i]))
+            )
+        return out
+
+    def format_states(self) -> str:
+        # same line format as FailedChallengeRateLimitStates.format_states
+        return "".join(
+            f"{ip},: interval_start: {start}, num hits: {hits}\n"
+            for ip, hits, start in self._entries()
+        )
+
+    def close(self) -> None:
+        self._base_ptr = None
+        self._shm.close()
+
+    def unlink(self) -> None:
+        if self.owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass

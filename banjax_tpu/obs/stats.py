@@ -59,14 +59,19 @@ class MatcherStats:
         if device_windows is not None:
             out["DeviceWindowsOccupancy"] = device_windows.occupancy
             out["DeviceWindowsCapacity"] = device_windows.capacity
-            out["DeviceWindowsEvictions"] = device_windows.eviction_count
+            # single read: an eviction landing between two reads must not be
+            # dropped from the next interval's delta
+            evictions = device_windows.eviction_count
+            out["DeviceWindowsEvictions"] = evictions
             # churn rate: evictions in THIS reporting interval — degraded
             # (spill/restore) mode is visible per 29 s line, not only as a
-            # lifetime total
+            # lifetime total.  Interval deltas assume a single periodic
+            # consumer (the metrics loop); ad-hoc snapshot() callers steal
+            # the delta from the next metrics line.
             out["DeviceWindowsEvictionsPerInterval"] = (
-                device_windows.eviction_count - self._last_evictions
+                evictions - self._last_evictions
             )
-            self._last_evictions = device_windows.eviction_count
+            self._last_evictions = evictions
             out["DeviceWindowsGrows"] = getattr(device_windows, "grow_count", 0)
             # shadowed IPs = all IPs with live counters (evicted included —
             # spill keeps them; see matcher/windows.py)

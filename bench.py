@@ -227,7 +227,8 @@ def _save_section(name: str, backend: str, data: dict) -> None:
     one; TPU overwrites TPU (newer code wins); CPU overwrites CPU."""
     p = _load_partial()
     prev = p["sections"].get(name)
-    if prev and prev.get("backend") == "tpu" and backend != "tpu":
+    # 'meta' is bookkeeping (skip lists), not evidence — always refresh it.
+    if name != "meta" and prev and prev.get("backend") == "tpu" and backend != "tpu":
         return
     p["sections"][name] = {
         "backend": backend,
@@ -574,9 +575,11 @@ def _sec_ladder(jax, ctx, backend, deadline, out) -> dict:
         try:
             with redirect_stdout(buf):
                 fn()
-            lad[f"config{n}"] = json.loads(
+            lps = json.loads(
                 buf.getvalue().strip().splitlines()[-1]
             )["lines_per_sec"]
+            lad[f"config{n}"] = lps
+            lad[f"config{n}_target_fraction"] = round((lps or 0) / TARGET, 4)
             out["ladder"] = lad
         except Exception as exc:  # noqa: BLE001 — one config failing keeps the rest
             measured = None
@@ -590,7 +593,14 @@ def _sec_ladder(jax, ctx, backend, deadline, out) -> dict:
                 "lines_per_sec": measured,
                 "error": f"{type(exc).__name__}: {exc}",
             }
+            lad[f"config{n}_target_fraction"] = round(
+                (measured or 0) / TARGET, 4
+            )
             out["ladder"] = lad
+    # machine-readable progress toward BASELINE.md's >=5M lines/s: the
+    # best ladder fraction (config3 is the 1k-rule north-star shape)
+    fracs = [v for k, v in lad.items() if k.endswith("_target_fraction")]
+    out["ladder_best_target_fraction"] = max(fracs) if fracs else None
     return out
 
 
@@ -622,6 +632,12 @@ def _sec_http(jax, ctx, backend, deadline, out) -> dict:
             out["auth_request_rps"] = row["rps"]
         elif row.get("benchmark") == "protected_paths":
             out["protected_paths_rps"] = row["rps"]
+        elif row.get("benchmark") == "auth_request_capacity":
+            out["auth_request_capacity_rps"] = row["rps"]
+            out["http_cpu_count"] = row.get("cpu_count")
+        elif row.get("benchmark") == "auth_request_capacity_workers":
+            out["auth_request_capacity_workers_rps"] = row["rps"]
+            out["http_workers"] = row.get("http_workers")
     out["http_bench_rc"] = int(rc)
     return out
 
@@ -693,7 +709,7 @@ def _compose(partial: dict, live_sections: "set", probe: str,
         sec_meta[name] = {
             "backend": ent["backend"], "measured_at": ent["measured_at"],
         }
-        if ent["backend"] == "tpu":
+        if ent["backend"] == "tpu" and name != "meta":
             any_tpu = True
         if name not in live_sections:
             merged_from_partial.append(name)

@@ -1,0 +1,166 @@
+"""Multi-worker (SO_REUSEPORT) serving mode, end-to-end.
+
+The reference is one Go process (http_server.go:32); our multi-process
+mode (httpapi/workers.py) must preserve its decision semantics across
+process boundaries: shared failed-challenge counting (native shm table),
+ban propagation (worker -> primary -> broadcast), cold-route proxying,
+and SIGHUP reload fan-out.  Each request below uses a FRESH connection so
+the kernel's SO_REUSEPORT hashing spreads them across the processes.
+"""
+
+import os
+import time
+from pathlib import Path
+
+import pytest
+import requests
+
+from banjax_tpu.native import shm
+
+pytestmark = pytest.mark.skipif(
+    not shm.available(), reason="no C compiler for native shmstate"
+)
+
+BASE = "http://localhost:8081"
+_FIXTURES = Path(__file__).resolve().parent.parent / "fixtures"
+
+
+def _auth(path, ip, cookies=None):
+    # NO session: a fresh TCP connection per request, so consecutive
+    # requests land on different SO_REUSEPORT listeners
+    return requests.get(
+        f"{BASE}/auth_request", params={"path": path},
+        headers={"X-Client-IP": ip}, cookies=cookies or {}, timeout=5,
+    )
+
+
+@pytest.fixture()
+def workers_app(app_factory, tmp_path):
+    custom = tmp_path / "banjax-config-workers.yaml"
+    custom.write_text(
+        (_FIXTURES / "banjax-config-test.yaml").read_text()
+        + "\nhttp_workers: 2\n"
+    )
+    app = app_factory(str(custom))
+    # wait until both worker processes hold the port (they answer /info)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if all(p.poll() is None for p in app._supervisor._procs):
+            try:
+                requests.get(f"{BASE}/info", timeout=2)
+                break
+            except requests.RequestException:
+                pass
+        time.sleep(0.2)
+    time.sleep(1.0)  # let late binders finish
+    assert all(p.poll() is None for p in app._supervisor._procs), (
+        "worker process died at startup"
+    )
+    return app
+
+
+def test_workers_failed_challenge_lockout_across_processes(workers_app):
+    """The failed-challenge lockout (banjax_integration_test.go:232-250)
+    with every 401 potentially served by a different process: the shm
+    table must count them as one stream."""
+    ip = "23.23.23.23"
+    statuses = [
+        _auth("wp-admin/x", ip, {"deflect_password3": "garbage"}).status_code
+        for _ in range(6)
+    ]
+    # threshold 5 in the fixture: six failures all render the password page
+    # (the exceed lands on the 6th; its response is still 401)
+    assert statuses == [401] * 6, statuses
+
+    # the ban propagates to every replica within the broadcast latency
+    deadline = time.time() + 5
+    banned = False
+    while time.time() < deadline:
+        if _auth("wp-admin/x", ip).status_code == 403:
+            banned = True
+            break
+        time.sleep(0.1)
+    assert banned, "ban did not propagate to the serving process"
+
+    # ... and is authoritative on the primary (cold route, any process
+    # proxies it there)
+    r = requests.get(f"{BASE}/is_banned", params={"ip": ip}, timeout=5)
+    body = r.json()
+    assert body["expiringDecision"] is not None
+    assert body["expiringDecision"]["Decision"] == "IptablesBlock"
+
+    # once banned, EVERY process serves 403 (spread over fresh conns)
+    codes = {_auth("/", ip).status_code for _ in range(6)}
+    assert codes == {403}, codes
+
+
+def test_workers_cold_routes_proxied(workers_app):
+    """All primary-owned routes answer correctly regardless of which
+    process the kernel hands the connection to."""
+    for _ in range(4):  # several fresh connections -> several processes
+        r = requests.get(f"{BASE}/rate_limit_states", timeout=5)
+        assert r.status_code == 200 and "failed challenges:" in r.text
+        r = requests.get(f"{BASE}/decision_lists", timeout=5)
+        assert r.status_code == 200 and "per_site:" in r.text
+        r = requests.get(f"{BASE}/ipset/list", timeout=5)
+        assert r.status_code == 200 and "entries" in r.json()
+        r = requests.get(f"{BASE}/info", timeout=5)
+        assert r.status_code == 200 and "config_version" in r.json()
+
+
+def test_workers_shared_fc_states_visible_in_introspection(workers_app):
+    ip = "24.24.24.24"
+    for _ in range(2):
+        _auth("wp-admin/x", ip, {"deflect_password3": "garbage"})
+    # the proxied /rate_limit_states reads the SAME shm table the workers
+    # counted into
+    r = requests.get(f"{BASE}/rate_limit_states", timeout=5)
+    assert f"{ip},: interval_start: " in r.text
+
+
+def test_workers_reload_fans_out(workers_app, tmp_path):
+    """SIGHUP on the primary rewrites worker config too (config_version
+    served by every process converges on the new value)."""
+    app = workers_app
+    cfg_path = Path(app.config_holder.path)
+    new_version = "2033-03-03_03:03:03"
+    text = cfg_path.read_text().replace(
+        "config_version: 2022-01-02_00:00:00",
+        f"config_version: {new_version}",
+    )
+    assert new_version in text, "fixture version marker changed"
+    cfg_path.write_text(text)
+
+    app.reload()  # the SIGHUP body; broadcasts {op: reload}
+
+    deadline = time.time() + 10
+    seen = set()
+    while time.time() < deadline:
+        seen = {
+            requests.get(f"{BASE}/info", timeout=5).json()["config_version"]
+            for _ in range(6)
+        }
+        if seen == {new_version}:
+            break
+        time.sleep(0.2)
+    assert seen == {new_version}, f"stale config still served: {seen}"
+
+
+def test_workers_survive_worker_kill(workers_app):
+    """Killing one worker must not take the service down: remaining
+    listeners keep answering every route."""
+    app = workers_app
+    victim = app._supervisor._procs[0]
+    victim.terminate()
+    victim.wait(timeout=5)
+    deadline = time.time() + 5
+    ok = 0
+    while time.time() < deadline and ok < 10:
+        try:
+            r = _auth("/", f"30.30.30.{ok + 1}")
+            if r.status_code == 200:
+                ok += 1
+        except requests.RequestException:
+            pass  # a connection may land on the dead listener's backlog
+        time.sleep(0.05)
+    assert ok >= 10, "service did not keep answering after a worker died"

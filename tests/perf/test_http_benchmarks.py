@@ -8,7 +8,9 @@ runs the harness as a smoke; here each prints a requests/sec JSON line and
 asserts a conservative floor so a server-path perf regression fails CI.
 """
 
+import asyncio
 import json
+import os
 import random
 import time
 
@@ -22,6 +24,54 @@ BASE = "http://localhost:8081"
 # to compare against, so the floor only guards OUR regressions)
 AUTH_FLOOR_RPS = 150
 PROTECTED_FLOOR_RPS = 150
+# server-capacity floor: concurrent raw-socket keepalive client, which
+# costs ~30 us/req instead of requests' ~1 ms — this is the number
+# comparable to driving the reference's Go server with its Go client
+CAPACITY_FLOOR_RPS = 800
+
+
+async def _capacity_worker(n: int, results: list, rand_ip) -> None:
+    reader, writer = await asyncio.open_connection("127.0.0.1", 8081)
+    for _ in range(n):
+        writer.write(
+            (
+                f"GET /auth_request HTTP/1.1\r\nHost: localhost\r\n"
+                f"X-Client-IP: {rand_ip()}\r\nConnection: keep-alive\r\n\r\n"
+            ).encode()
+        )
+        await writer.drain()
+        hdr = await reader.readuntil(b"\r\n\r\n")
+        clen = 0
+        for line in hdr.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                clen = int(line.split(b":")[1])
+        if clen:
+            await reader.readexactly(clen)
+        results[0] += 1
+    writer.close()
+
+
+def measure_capacity(n_per_conn: int = 400, conc: int = 16,
+                     seed: int = 11) -> float:
+    """Sustained /auth_request throughput with a cheap concurrent client
+    (the serial python-requests harnesses above are client-bound)."""
+    rng = random.Random(seed)
+
+    def rand_ip():
+        return (
+            f"{rng.randint(1, 251)}.{rng.randint(0, 255)}"
+            f".{rng.randint(0, 255)}.{rng.randint(1, 254)}"
+        )
+
+    async def run() -> float:
+        results = [0]
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *[_capacity_worker(n_per_conn, results, rand_ip) for _ in range(conc)]
+        )
+        return results[0] / (time.perf_counter() - t0)
+
+    return asyncio.run(run())
 
 
 @pytest.fixture()
@@ -77,3 +127,41 @@ def test_benchmark_protected_paths(app):
     rps = iters * len(paths) / (time.perf_counter() - t0)
     print(json.dumps({"benchmark": "protected_paths", "rps": round(rps, 1)}))
     assert rps >= PROTECTED_FLOOR_RPS
+
+
+def test_benchmark_auth_request_capacity(app):
+    """Server capacity (single process): the concurrent keepalive client
+    measures the handler path itself, not the python-requests client."""
+    measure_capacity(n_per_conn=40, conc=8)  # warm
+    rps = measure_capacity()
+    print(json.dumps({
+        "benchmark": "auth_request_capacity", "rps": round(rps, 1),
+        "http_workers": 0, "cpu_count": os.cpu_count(),
+    }))
+    assert rps >= CAPACITY_FLOOR_RPS
+
+
+@pytest.mark.skipif((os.cpu_count() or 1) < 2,
+                    reason="SO_REUSEPORT workers need >1 core to scale")
+def test_benchmark_auth_request_capacity_workers(app_factory, tmp_path):
+    """Server capacity in multi-worker mode (httpapi/workers.py):
+    http_workers = cpu count, one SO_REUSEPORT process per core."""
+    from pathlib import Path
+
+    n_workers = os.cpu_count()
+    fixtures = Path(__file__).resolve().parent.parent / "fixtures"
+    custom = tmp_path / "banjax-config-workers.yaml"
+    custom.write_text(
+        (fixtures / "banjax-config-test.yaml").read_text()
+        + f"\nhttp_workers: {n_workers}\n"
+    )
+    # app_factory joins against the fixtures dir; an absolute path wins
+    app_factory(str(custom))
+    time.sleep(2.0)  # let workers bind
+    measure_capacity(n_per_conn=40, conc=8)  # warm
+    rps = measure_capacity(conc=32)
+    print(json.dumps({
+        "benchmark": "auth_request_capacity_workers", "rps": round(rps, 1),
+        "http_workers": n_workers, "cpu_count": os.cpu_count(),
+    }))
+    assert rps >= CAPACITY_FLOOR_RPS

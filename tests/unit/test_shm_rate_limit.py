@@ -1,0 +1,166 @@
+"""Shared-memory failed-challenge rate limiter (native/shmstate.c).
+
+Differential against the pure-Python FailedChallengeRateLimitStates
+(decisions/rate_limit.py) — same window quirks (strict >, exceed resets
+hits to 0; rate_limit.go:125-156) — plus the multi-process counting
+property the table exists for.
+"""
+
+import multiprocessing
+import random
+import time
+import types
+
+import pytest
+
+from banjax_tpu.decisions.rate_limit import FailedChallengeRateLimitStates
+from banjax_tpu.native import shm
+
+pytestmark = pytest.mark.skipif(
+    not shm.available(), reason="no C compiler for native shmstate"
+)
+
+
+def _cfg(interval_s=60, threshold=3):
+    return types.SimpleNamespace(
+        too_many_failed_challenges_interval_seconds=interval_s,
+        too_many_failed_challenges_threshold=threshold,
+    )
+
+
+def test_differential_sequential():
+    cfg = _cfg(interval_s=1, threshold=3)
+    table = shm.ShmFailedChallengeStates(capacity=1024)
+    py = FailedChallengeRateLimitStates()
+    rng = random.Random(7)
+    ips = [f"10.1.{i // 256}.{i % 256}" for i in range(80)]
+    try:
+        for step in range(3000):
+            ip = rng.choice(ips)
+            a = table.apply(ip, cfg)
+            b = py.apply(ip, cfg)
+            assert (a.match_type, a.exceeded) == (b.match_type, b.exceeded), (
+                step, ip, a, b,
+            )
+        assert len(table) == len(py)
+        assert table.dropped == 0
+    finally:
+        table.close()
+        table.unlink()
+
+
+def test_window_rollover_differential():
+    """OUTSIDE_INTERVAL transitions with a real elapsed interval."""
+    cfg = _cfg(interval_s=0, threshold=2)  # every >0ns gap rolls the window
+    table = shm.ShmFailedChallengeStates(capacity=64)
+    py = FailedChallengeRateLimitStates()
+    try:
+        for _ in range(20):
+            a = table.apply("9.9.9.9", cfg)
+            b = py.apply("9.9.9.9", cfg)
+            assert (a.match_type, a.exceeded) == (b.match_type, b.exceeded)
+            time.sleep(0.001)
+    finally:
+        table.close()
+        table.unlink()
+
+
+def test_format_states_shape():
+    cfg = _cfg()
+    table = shm.ShmFailedChallengeStates(capacity=64)
+    try:
+        table.apply("1.2.3.4", cfg)
+        table.apply("1.2.3.4", cfg)
+        out = table.format_states()
+        # same line shape as FailedChallengeRateLimitStates.format_states
+        assert out.startswith("1.2.3.4,: interval_start: ")
+        assert ", num hits: 2\n" in out
+    finally:
+        table.close()
+        table.unlink()
+
+
+def test_attach_shares_state():
+    cfg = _cfg()
+    owner = shm.ShmFailedChallengeStates(capacity=64)
+    try:
+        owner.apply("5.5.5.5", cfg)
+        attached = shm.ShmFailedChallengeStates(name=owner.name)
+        r = attached.apply("5.5.5.5", cfg)
+        assert r.match_type.name == "INSIDE_INTERVAL"
+        assert len(attached) == 1
+        attached.close()
+    finally:
+        owner.close()
+        owner.unlink()
+
+
+def test_full_window_steals_stalest_expired():
+    """With every slot in the probe window expired, a new key steals one
+    (semantically identical to an OUTSIDE_INTERVAL restart)."""
+    cfg = _cfg(interval_s=0, threshold=100)  # everything expires instantly
+    table = shm.ShmFailedChallengeStates(capacity=64)  # tiny: forces fills
+    try:
+        for i in range(500):
+            r = table.apply(f"ip-{i}", cfg)
+            assert r.match_type.name == "FIRST_TIME"
+        time.sleep(0.001)
+        assert table.dropped == 0  # expired slots always stealable
+        assert len(table) <= 64
+    finally:
+        table.close()
+        table.unlink()
+
+
+def test_full_window_unexpired_degrades_with_dropped_count():
+    cfg = _cfg(interval_s=3600, threshold=100)
+    table = shm.ShmFailedChallengeStates(capacity=64)
+    try:
+        for i in range(500):
+            table.apply(f"ip-{i}", cfg)
+        # 64 slots, probe window 64: once full and nothing expired, new
+        # keys degrade to unstored first hits
+        assert table.dropped > 0
+        r = table.apply("brand-new-ip", cfg)
+        assert r.match_type.name == "FIRST_TIME" and not r.exceeded
+    finally:
+        table.close()
+        table.unlink()
+
+
+def _hammer(name: str, n: int, q) -> None:
+    t = shm.ShmFailedChallengeStates(name=name)
+    cfg = _cfg(interval_s=3600, threshold=3)
+    exceeded = 0
+    for _ in range(n):
+        if t.apply("77.77.77.77", cfg).exceeded:
+            exceeded += 1
+    t.close()
+    q.put(exceeded)
+
+
+def test_multiprocess_counting_exact():
+    """4 processes x 1000 applies on ONE ip: with threshold T the counter
+    cycles 1..T+1 (exceed resets to 0), so exactly N // (T+1) exceeds must
+    be observed across all processes — the per-slot lock serializes every
+    transition, no hit may be lost or double-counted."""
+    ctx = multiprocessing.get_context("spawn")
+    table = shm.ShmFailedChallengeStates(capacity=256)
+    try:
+        q = ctx.Queue()
+        per = 1000
+        procs = [
+            ctx.Process(target=_hammer, args=(table.name, per, q))
+            for _ in range(4)
+        ]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join(timeout=60)
+            assert p.exitcode == 0
+        total_exceeded = sum(q.get(timeout=5) for _ in range(4))
+        assert total_exceeded == (4 * per) // 4  # T=3 -> cycle length 4
+        assert table.dropped == 0
+    finally:
+        table.close()
+        table.unlink()

@@ -134,3 +134,66 @@ regexes_with_rates: []
         base + "global_decision_lists:\n  nginx_block:\n    - 3.3.3.3\n"
     ))
     assert not sl3.has_any_allow_entries()
+
+
+def test_ipfilter_fast_path_differential():
+    """The inet_pton membership fast path agrees with the ipaddress-module
+    slow path on every accept/reject edge case (IPFilter.allowed)."""
+    import ipaddress
+
+    from banjax_tpu.decisions.static_lists import IPFilter
+
+    entries = [
+        "20.20.20.20", "10.0.0.0/8", "192.168.1.0/24", "2001:db8::1",
+        "2001:db8:1::/48", "255.255.255.255", "0.0.0.0/0 oops", "garbage",
+    ]
+    f = IPFilter([e for e in entries if "oops" not in e])
+
+    def slow(ip_string):
+        try:
+            addr = ipaddress.ip_address(ip_string)
+        except ValueError:
+            return False
+        nets = [
+            ipaddress.ip_network(e, strict=False)
+            for e in entries
+            if "/" in e and "oops" not in e
+        ]
+        singles = {
+            ipaddress.ip_address(e)
+            for e in entries
+            if "/" not in e and e not in ("garbage",)
+        }
+        return addr in singles or any(addr in n for n in nets)
+
+    cases = [
+        "20.20.20.20", "20.20.20.21", "10.1.2.3", "11.1.2.3",
+        "192.168.1.77", "192.168.2.77", "2001:db8::1", "2001:db8::2",
+        "2001:db8:1::ffff", "2001:db8:2::ffff", "255.255.255.255",
+        # reject-form edge cases: both paths must agree on rejection
+        "01.2.3.4", "1.2.3", "1.2.3.4.5", " 1.2.3.4", "1.2.3.4 ",
+        "256.1.1.1", "1.2.3.04", "", "::", "::1", "not-an-ip",
+        "10.0.0.0/8",  # a CIDR is not an address
+        "0x0a.1.2.3",
+    ]
+    import random
+
+    rng = random.Random(5)
+    for _ in range(500):
+        cases.append(
+            f"{rng.randint(0, 299)}.{rng.randint(0, 299)}"
+            f".{rng.randint(0, 299)}.{rng.randint(0, 299)}"
+        )
+    for ip in cases:
+        assert f.allowed(ip) == slow(ip), ip
+
+
+def test_ipfilter_scoped_ipv6_slow_path():
+    """Scoped IPv6 input falls back to ipaddress-module semantics."""
+    from banjax_tpu.decisions.static_lists import IPFilter
+
+    f = IPFilter(["fe80::1"])
+    assert f.allowed("fe80::1") is True
+    # a scoped input is not equal to the unscoped single (ipaddress
+    # equality includes the zone), so it must NOT match
+    assert f.allowed("fe80::1%eth0") is False
