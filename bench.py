@@ -544,11 +544,87 @@ def _sec_mesh(jax, ctx, backend, deadline, out) -> dict:
     for _ in range(n):
         be.match_bits(cls_ids, lens)
     elapsed = time.perf_counter() - t0
-    out["mesh_lines_per_sec"] = round(batch * n / elapsed, 1)
-    out["mesh_shape"] = {"dp": 1, "rp": 1}
+    # labeled single-device row: this is NOT a parallel measurement — it
+    # proves the sharded code path compiles + runs on the attached silicon
+    out["mesh_singledev_lines_per_sec"] = round(batch * n / elapsed, 1)
+    out["mesh_singledev_shape"] = {"dp": 1, "rp": 1}
+    out["mesh_singledev_backend"] = backend
     out["mesh_batch"] = batch
     out["mesh_fused_batches"] = be.fused_batches
+
+    # the real multi-device execution record: dp=2 x rp=4 COMPILED (XLA,
+    # non-interpret) over 8 virtual CPU devices in a fresh subprocess.
+    # Scaling numbers on virtual devices are meaningless (one physical
+    # core) — the row proves compiled multi-device execution and is
+    # labeled with its backend so it can never masquerade as a chip number.
+    if deadline.over("mesh_multidev"):
+        out["mesh_multidev"] = None
+        return out
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=flags)
+    env.pop("BENCH_SECTIONS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _MESH_MULTIDEV_CHILD, _DIR],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        if r.returncode == 0:
+            out["mesh_multidev"] = json.loads(
+                r.stdout.strip().splitlines()[-1]
+            )
+        else:
+            out["mesh_multidev"] = {"error": (r.stderr or "no output")[-500:]}
+    except Exception as exc:  # noqa: BLE001 — empty stdout / timeout /
+        # bad JSON must not zero the section's singledev row
+        out["mesh_multidev"] = {"error": f"{type(exc).__name__}: {exc}"}
     return out
+
+
+_MESH_MULTIDEV_CHILD = r"""
+import json, sys, time
+sys.path.insert(0, sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import bench
+from banjax_tpu.matcher.encode import encode_for_match
+from banjax_tpu.matcher.prefilter import build_plan
+from banjax_tpu.matcher.rulec import compile_rules
+from banjax_tpu.parallel import mesh as pmesh
+
+assert len(jax.devices()) >= 8, jax.devices()
+patterns = bench.generate_rules(bench.N_RULES)
+# the rp axis shards the packed word dimension: compile with n_shards=rp
+# so every shard is padded to the same width (what the dryrun does too)
+compiled = compile_rules(patterns, n_shards=4)
+plan = build_plan(
+    patterns, byte_classes=(compiled.byte_to_class, compiled.n_classes),
+    stage2_shards=4,
+)
+m = pmesh.make_mesh(8, rp=4)
+be = pmesh.ShardedMatchBackend(
+    compiled, m, bench.MAX_LEN, backend="xla", block_b=128, plan=plan,
+)
+batch = 4096
+lines = bench.generate_lines(batch, patterns, seed=41)
+cls_ids, lens, _ = encode_for_match(compiled, lines, bench.MAX_LEN)
+be.match_bits(cls_ids, lens)  # compile
+n = 3
+t0 = time.perf_counter()
+for _ in range(n):
+    be.match_bits(cls_ids, lens)
+elapsed = time.perf_counter() - t0
+print(json.dumps({
+    "lines_per_sec": round(batch * n / elapsed, 1),
+    "shape": {"dp": 2, "rp": 4},
+    "backend": "cpu-virtual-8dev",
+    "compiled": True,
+    "interpret": False,
+    "batch": batch,
+    "fused_batches": be.fused_batches,
+}))
+"""
 
 
 def _sec_ladder(jax, ctx, backend, deadline, out) -> dict:
