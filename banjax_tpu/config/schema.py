@@ -184,6 +184,11 @@ class Config:
     # side effects forwarded to the primary. Needs a C compiler at
     # first start (native/shmstate.c); falls back to 0 without one.
     http_workers: int = 0
+    # native asyncio-protocol server for the /auth_request hot path
+    # (httpapi/fastserve.py): ~2-3x the aiohttp requests/sec, identical
+    # wire contract (cold routes proxied to the aiohttp app over a unix
+    # socket). false restores the pure-aiohttp layout.
+    http_fast_path: bool = True
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -217,6 +222,7 @@ _SCALAR_KEYS = {
     "matcher_prefilter_cand_frac": float,
     "matcher_mesh_devices": int, "matcher_mesh_rp": int,
     "matcher_native_parse": bool, "http_workers": int,
+    "http_fast_path": bool,
 }
 
 _DICT_OR_LIST_KEYS = {

@@ -523,24 +523,110 @@ def _register_profile_routes(app: web.Application) -> None:
     app.router.add_get("/debug/jax/trace", jax_trace)
 
 
+class ServerHandle:
+    """Uniform shutdown handle over the possible server layouts (aiohttp
+    runner, fast-path asyncio server, temp unix-socket dir)."""
+
+    def __init__(self, runner=None, fast_server=None, tmpdir=None,
+                 fast_logs=()):
+        self.runner = runner
+        self.fast_server = fast_server
+        self._tmpdir = tmpdir
+        self._fast_logs = fast_logs
+
+    async def cleanup(self) -> None:
+        if self.fast_server is not None:
+            self.fast_server.close()
+            await self.fast_server.wait_closed()
+        for lg in self._fast_logs:
+            lg._flush()
+        if self.runner is not None:
+            await self.runner.cleanup()
+        if self._tmpdir is not None:
+            import shutil
+
+            shutil.rmtree(self._tmpdir, ignore_errors=True)
+
+
 async def run_http_server(
     deps: ServerDeps,
     reuse_port: bool = False,
     unix_path: Optional[str] = None,
     worker_proxy_sock: Optional[str] = None,
-) -> web.AppRunner:
-    """Start the server; returns the runner for clean shutdown.
+) -> ServerHandle:
+    """Start the server; returns a handle for clean shutdown.
+
+    Layouts (config key `http_fast_path`, default on):
+
+      fast on  — the native protocol server (httpapi/fastserve.py) owns
+        127.0.0.1:8081 and answers the hot routes; the full aiohttp app
+        listens on a unix socket and receives everything else by raw
+        proxy.  In multi-worker mode workers pass `worker_proxy_sock`
+        (the primary's unix socket) and run NO local aiohttp at all.
+      fast off — the aiohttp app serves 8081 directly (the r4 layout).
 
     Multi-worker mode (httpapi/workers.py): every process passes
     `reuse_port=True` so the kernel load-balances 127.0.0.1:8081 across
     them; the primary also passes `unix_path` (its cold-route listener for
-    worker proxies) and workers pass `worker_proxy_sock`."""
-    app = build_app(deps, worker_proxy_sock=worker_proxy_sock)
+    worker proxies)."""
+    from banjax_tpu.httpapi.fastserve import start_fast_server
+
+    config0 = deps.config_holder.get()
+    fast = bool(getattr(config0, "http_fast_path", True))
+
+    if not fast:
+        app = build_app(deps, worker_proxy_sock=worker_proxy_sock)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, LISTEN_HOST, LISTEN_PORT,
+                           reuse_port=reuse_port)
+        await site.start()
+        if unix_path is not None:
+            await web.UnixSite(runner, unix_path).start()
+        log.info("http server listening on %s:%s", LISTEN_HOST, LISTEN_PORT)
+        return ServerHandle(runner=runner)
+
+    gin_log = (
+        CoalescedLog(deps.gin_log_file) if deps.gin_log_file is not None
+        else None
+    )
+    server_log = (
+        CoalescedLog(deps.server_log_file)
+        if (config0.standalone_testing and deps.server_log_file is not None)
+        else None
+    )
+    fast_logs = [lg for lg in (gin_log, server_log) if lg is not None]
+
+    if worker_proxy_sock is not None:
+        # worker: the fast server IS the whole process surface; cold
+        # routes raw-proxy to the primary's unix socket
+        fast_server = await start_fast_server(
+            deps, worker_proxy_sock, LISTEN_HOST, LISTEN_PORT,
+            reuse_port=True, coalesced_gin=gin_log,
+            coalesced_server=server_log,
+        )
+        log.info("fast http worker listening on %s:%s",
+                 LISTEN_HOST, LISTEN_PORT)
+        return ServerHandle(fast_server=fast_server, fast_logs=fast_logs)
+
+    # primary / single process: full aiohttp app on a unix socket (the
+    # fast server's cold-route upstream — and the worker proxy target in
+    # multi-worker mode), fast server on the TCP port
+    tmpdir = None
+    if unix_path is None:
+        import tempfile
+
+        tmpdir = tempfile.mkdtemp(prefix="banjax-http-")
+        unix_path = os.path.join(tmpdir, "app.sock")
+    app = build_app(deps)
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, LISTEN_HOST, LISTEN_PORT, reuse_port=reuse_port)
-    await site.start()
-    if unix_path is not None:
-        await web.UnixSite(runner, unix_path).start()
-    log.info("http server listening on %s:%s", LISTEN_HOST, LISTEN_PORT)
-    return runner
+    await web.UnixSite(runner, unix_path).start()
+    fast_server = await start_fast_server(
+        deps, unix_path, LISTEN_HOST, LISTEN_PORT, reuse_port=reuse_port,
+        coalesced_gin=gin_log, coalesced_server=server_log,
+    )
+    log.info("fast http server on %s:%s (aiohttp upstream %s)",
+             LISTEN_HOST, LISTEN_PORT, unix_path)
+    return ServerHandle(runner=runner, fast_server=fast_server,
+                        tmpdir=tmpdir, fast_logs=fast_logs)

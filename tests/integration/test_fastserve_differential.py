@@ -1,0 +1,169 @@
+"""Wire-contract differential: fastserve vs the aiohttp layout.
+
+The native protocol server (httpapi/fastserve.py) must emit the same
+responses as the aiohttp application for the same requests — status,
+content type, decision headers, cookie names/attributes, and body bytes
+(bodies are config-deterministic; cookie VALUES are random/expiry-bound
+and compared by shape).  Runs the same request corpus against both
+layouts (`http_fast_path: true` / `false`) and diffs.
+"""
+
+import time
+from pathlib import Path
+
+import pytest
+import requests
+
+BASE = "http://localhost:8081"
+_FIXTURES = Path(__file__).resolve().parent.parent / "fixtures"
+
+CORPUS = [
+    # (method, path-with-query, headers, cookies, data)
+    ("GET", "/auth_request?path=/", {"X-Client-IP": "41.41.41.1"}, {}, None),
+    ("GET", "/auth_request?path=wp-admin/x", {"X-Client-IP": "41.41.41.2"}, {}, None),
+    ("GET", "/auth_request?path=wp-admin/x", {"X-Client-IP": "41.41.41.3"},
+     {"deflect_password3": "garbage"}, None),
+    ("GET", "/auth_request?path=wp-admin/admin-ajax.php",
+     {"X-Client-IP": "41.41.41.4"}, {}, None),
+    ("POST", "/auth_request?path=/", {"X-Client-IP": "41.41.41.5"}, {}, None),
+    ("GET", "/auth_request?path=/x", {"X-Client-IP": "8.8.8.8"}, {}, None),  # challenge-listed
+    ("GET", "/auth_request?path=/y", {"X-Client-IP": "70.80.90.100"}, {}, None),  # nginx_block
+    ("GET", "/info", {}, {}, None),
+    ("GET", "/is_banned?ip=5.6.7.8", {}, {}, None),
+    ("GET", "/decision_lists", {}, {}, None),
+    ("GET", "/rate_limit_states", {}, {}, None),
+    ("GET", "/banned?domain=example.com", {}, {}, None),
+    ("POST", "/unban", {}, {}, {"ip": "1.2.3.4"}),
+    ("GET", "/ipset/list", {}, {}, None),
+    ("GET", "/nonexistent", {}, {}, None),
+    # route/method edge cases: both layouts must agree (404/405 from the
+    # aiohttp router, not a fast-path misroute)
+    ("POST", "/info", {}, {}, None),
+    ("GET", "/auth_requested", {"X-Client-IP": "41.41.41.6"}, {}, None),
+    ("GET", "/auth_request/sub", {"X-Client-IP": "41.41.41.7"}, {}, None),
+    ("HEAD", "/decision_lists", {}, {}, None),
+]
+
+# headers whose values must match exactly between the two layouts
+_HEADERS_COMPARED = (
+    "Content-Type", "Cache-Control", "X-Accel-Redirect", "X-Banjax-Decision",
+    "X-Deflect-Session-New",
+)
+
+
+def _capture(app_factory, tmp_path, fast: bool, tag: str):
+    cfg = tmp_path / f"cfg-{tag}.yaml"
+    cfg.write_text(
+        (_FIXTURES / "banjax-config-test.yaml").read_text()
+        + f"\nhttp_fast_path: {'true' if fast else 'false'}\n"
+    )
+    app = app_factory(str(cfg))
+    time.sleep(0.5)
+    rows = []
+    for method, path, headers, cookies, data in CORPUS:
+        headers = dict(headers, Host="localhost:8081")
+        r = requests.request(
+            method, f"{BASE}{path}", headers=headers, cookies=cookies,
+            data=data, timeout=5, allow_redirects=False,
+        )
+        cookie_shapes = []
+        for sc in r.raw.headers.getlist("Set-Cookie"):
+            name = sc.split("=", 1)[0]
+            attrs = sorted(
+                a.strip().split("=", 1)[0].lower()
+                for a in sc.split(";")[1:]
+            )
+            cookie_shapes.append((name, tuple(attrs)))
+        rows.append({
+            "req": (method, path),
+            "status": r.status_code,
+            "headers": {
+                h: r.headers.get(h) for h in _HEADERS_COMPARED
+            },
+            "cookies": sorted(cookie_shapes),
+            "body_len": len(r.content),
+            "body": r.content if len(r.content) < 65536 else None,
+        })
+    app.stop_background()
+    return rows
+
+
+def test_fastserve_matches_aiohttp_wire_contract(app_factory, tmp_path):
+    slow = _capture(app_factory, tmp_path, fast=False, tag="aio")
+    fast = _capture(app_factory, tmp_path, fast=True, tag="fast")
+    for s, f in zip(slow, fast):
+        assert s["req"] == f["req"]
+        ctx = s["req"]
+        assert s["status"] == f["status"], (ctx, s["status"], f["status"])
+        assert s["headers"] == f["headers"], (ctx, s["headers"], f["headers"])
+        assert s["cookies"] == f["cookies"], (ctx, s["cookies"], f["cookies"])
+        if ctx[1].startswith("/auth_request"):
+            # bodies are config-deterministic (challenge/password pages,
+            # empty bodies); dynamic-route bodies may embed timestamps
+            assert s["body"] == f["body"], (ctx, s["body_len"], f["body_len"])
+
+
+def test_fastserve_handles_fragmented_and_pipelined_requests(app_factory, tmp_path):
+    """The hand parser must survive byte-dribbled heads and two requests
+    arriving in one TCP segment."""
+    import socket as sk
+
+    cfg = tmp_path / "cfg-frag.yaml"
+    cfg.write_text((_FIXTURES / "banjax-config-test.yaml").read_text())
+    app_factory(str(cfg))
+    time.sleep(0.5)
+
+    # fragmented: send the request a few bytes at a time
+    s = sk.create_connection(("127.0.0.1", 8081), timeout=5)
+    payload = (b"GET /auth_request?path=/ HTTP/1.1\r\nHost: localhost:8081\r\n"
+               b"X-Client-IP: 42.42.42.1\r\nConnection: keep-alive\r\n\r\n")
+    for i in range(0, len(payload), 7):
+        s.sendall(payload[i : i + 7])
+        time.sleep(0.002)
+    resp = s.recv(65536)
+    assert resp.startswith(b"HTTP/1.1 200"), resp[:80]
+
+    # pipelined: two requests in one segment on the same connection
+    s.sendall(payload + payload)
+    got = b""
+    deadline = time.time() + 5
+    while got.count(b"HTTP/1.1 200") < 2 and time.time() < deadline:
+        got += s.recv(65536)
+    assert got.count(b"HTTP/1.1 200") == 2, got[:200]
+    s.close()
+
+
+def test_fastserve_bad_requests(app_factory, tmp_path):
+    import socket as sk
+
+    cfg = tmp_path / "cfg-bad.yaml"
+    cfg.write_text((_FIXTURES / "banjax-config-test.yaml").read_text())
+    app_factory(str(cfg))
+    time.sleep(0.5)
+
+    s = sk.create_connection(("127.0.0.1", 8081), timeout=5)
+    s.sendall(b"NONSENSE\r\n\r\n")
+    resp = s.recv(65536)
+    assert b"400" in resp.split(b"\r\n", 1)[0], resp[:80]
+    s.close()
+
+    # POST body present and consumed (route ignores it; must not desync
+    # the connection)
+    s = sk.create_connection(("127.0.0.1", 8081), timeout=5)
+    body = b"a=1&b=2"
+    s.sendall(
+        b"POST /auth_request?path=/ HTTP/1.1\r\nHost: localhost:8081\r\n"
+        b"X-Client-IP: 42.42.42.9\r\nContent-Length: %d\r\n"
+        b"Content-Type: application/x-www-form-urlencoded\r\n\r\n%b"
+        % (len(body), body)
+    )
+    resp = s.recv(65536)
+    assert resp.startswith(b"HTTP/1.1 200"), resp[:80]
+    # connection still usable after the body
+    s.sendall(
+        b"GET /auth_request?path=/ HTTP/1.1\r\nHost: localhost:8081\r\n"
+        b"X-Client-IP: 42.42.42.9\r\n\r\n"
+    )
+    resp = s.recv(65536)
+    assert resp.startswith(b"HTTP/1.1 200"), resp[:80]
+    s.close()

@@ -26,8 +26,11 @@ AUTH_FLOOR_RPS = 150
 PROTECTED_FLOOR_RPS = 150
 # server-capacity floor: concurrent raw-socket keepalive client, which
 # costs ~30 us/req instead of requests' ~1 ms — this is the number
-# comparable to driving the reference's Go server with its Go client
-CAPACITY_FLOOR_RPS = 800
+# comparable to driving the reference's Go server with its Go client.
+# fastserve measures 5.6-7.6k on the 1-core build box (client sharing the
+# core); 2k still fails on any fast-path regression while leaving ~3x for
+# CI noise
+CAPACITY_FLOOR_RPS = 2_000
 
 
 async def _capacity_worker(n: int, results: list, rand_ip) -> None:
