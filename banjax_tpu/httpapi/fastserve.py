@@ -51,18 +51,17 @@ _REASONS = {
 MAX_HEADER_BYTES = 65536
 MAX_BODY_BYTES = 10 * 1024 * 1024
 
-# routes the protocol answers natively; everything else proxies upstream
-_HOT_PATHS = (b"/auth_request", b"/info", b"/favicon.ico")
-
 
 def _reason(status: int) -> str:
     return _REASONS.get(status, "Unknown")
 
 
-def serialize_response(resp: Response, keep_alive: bool) -> bytes:
+def serialize_response(resp: Response, keep_alive: bool,
+                       head_only: bool = False) -> bytes:
     """Response dataclass → HTTP/1.1 bytes (matches what the aiohttp app
-    emits for the same Response: status, Content-Type with charset for
-    text types, custom headers, gin-escaped cookies)."""
+    emits for the same Response: status, bare content_type, custom
+    headers, gin-escaped cookies).  head_only keeps Content-Length but
+    suppresses the body bytes (RFC 7230 HEAD semantics)."""
     body = resp.body if isinstance(resp.body, bytes) else str(resp.body).encode()
     # no charset suffix: the aiohttp app emits the bare content_type for
     # byte bodies (differential-tested)
@@ -86,17 +85,17 @@ def serialize_response(resp: Response, keep_alive: bool) -> bytes:
             attrs.append("HttpOnly")
         lines.append("Set-Cookie: " + "; ".join(attrs))
     lines.append("Connection: keep-alive" if keep_alive else "Connection: close")
-    return ("\r\n".join(lines) + "\r\n\r\n").encode() + body
+    head = ("\r\n".join(lines) + "\r\n\r\n").encode()
+    return head if head_only else head + body
 
 
 class _ParsedRequest:
-    __slots__ = ("method", "target", "path", "query", "headers", "body",
+    __slots__ = ("method", "path", "query", "headers", "body",
                  "keep_alive", "raw_head")
 
-    def __init__(self, method, target, path, query, headers, body,
+    def __init__(self, method, path, query, headers, body,
                  keep_alive, raw_head):
         self.method = method
-        self.target = target          # bytes, as received (for proxying)
         self.path = path              # str, decoded-less path component
         self.query = query            # raw query string (str)
         self.headers = headers        # dict[str(lower), str]
@@ -281,6 +280,15 @@ class FastHttpProtocol(asyncio.Protocol):
                 Response(status=400, body=b"bad request"), False))
             self.transport.close()
             return None
+        if "transfer-encoding" in headers:
+            # no chunked-request support: accepting the head with clen=0
+            # would leave the chunked body in the buffer to be re-parsed
+            # as a smuggled pipelined request
+            self.write(serialize_response(
+                Response(status=501, body=b"transfer-encoding unsupported"),
+                False))
+            self.transport.close()
+            return None
         clen = 0
         if "content-length" in headers:
             try:
@@ -301,13 +309,12 @@ class FastHttpProtocol(asyncio.Protocol):
         raw_head = bytes(self.buf[:head_len])
         body = bytes(self.buf[head_len : head_len + clen])
         del self.buf[: head_len + clen]
-        tb = target.encode("latin-1")
         path, _, query = target.partition("?")
         conn = headers.get("connection", "").lower()
         keep_alive = (version == "HTTP/1.1" and conn != "close") or (
             conn == "keep-alive"
         )
-        return _ParsedRequest(method, tb, path, query, headers, body,
+        return _ParsedRequest(method, path, query, headers, body,
                               keep_alive, raw_head)
 
     def write(self, data: bytes) -> None:
@@ -347,16 +354,17 @@ class FastPathServer:
         # --- standalone middleware (http_server.go:137-169) ---
         if self.standalone:
             client_ip = req.header("x-client-ip") or proto.peer or "127.0.0.1"
-            injected = {
+            query_path = req.query_param("path")  # parsed once per request
+            # req.headers is built fresh per request in _try_parse — safe
+            # to update in place (the reference mutates its shared header
+            # map the same way)
+            req.headers.update({
                 "x-client-ip": client_ip,
                 "x-requested-host": req.header("host"),
-                "x-requested-path": req.query_param("path"),
+                "x-requested-path": query_path,
                 "x-client-user-agent": req.header("x-client-user-agent")
                 or "mozilla",
-            }
-            hdrs = dict(req.headers)
-            hdrs.update(injected)
-            req.headers = hdrs
+            })
             if self.server_log is not None:
                 self.server_log.write(
                     "%f %s %s %s %s %s HTTP/1.1 %s\n"
@@ -366,7 +374,7 @@ class FastPathServer:
                         req.method,
                         req.header("host"),
                         req.method,
-                        req.query_param("path"),
+                        query_path,
                         req.header("user-agent"),
                     )
                 )
@@ -379,10 +387,14 @@ class FastPathServer:
             resp = Response(status=200, body=body,
                             content_type="application/json; charset=utf-8")
         elif path == "/favicon.ico":
-            resp = Response(status=200, body=b"")
+            # the aiohttp route uses web.Response(text="") — charset added
+            resp = Response(status=200, body=b"",
+                            content_type="text/plain; charset=utf-8")
         else:
             resp = self._auth_request(req)
-        proto.write(serialize_response(resp, req.keep_alive))
+        proto.write(serialize_response(
+            resp, req.keep_alive, head_only=req.method == "HEAD"
+        ))
 
         # --- access log middleware (http_server.go:65-95) ---
         if self.gin_log is not None:

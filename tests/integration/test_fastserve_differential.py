@@ -42,6 +42,8 @@ CORPUS = [
     ("GET", "/auth_requested", {"X-Client-IP": "41.41.41.6"}, {}, None),
     ("GET", "/auth_request/sub", {"X-Client-IP": "41.41.41.7"}, {}, None),
     ("HEAD", "/decision_lists", {}, {}, None),
+    ("HEAD", "/auth_request?path=/", {"X-Client-IP": "41.41.41.8"}, {}, None),
+    ("GET", "/favicon.ico", {}, {}, None),
 ]
 
 # headers whose values must match exactly between the two layouts
@@ -145,6 +147,41 @@ def test_fastserve_bad_requests(app_factory, tmp_path):
     s.sendall(b"NONSENSE\r\n\r\n")
     resp = s.recv(65536)
     assert b"400" in resp.split(b"\r\n", 1)[0], resp[:80]
+    s.close()
+
+    # chunked requests are rejected outright (501) rather than smuggling
+    # their body bytes into the next parse
+    s = sk.create_connection(("127.0.0.1", 8081), timeout=5)
+    s.sendall(
+        b"POST /auth_request HTTP/1.1\r\nHost: localhost:8081\r\n"
+        b"Transfer-Encoding: chunked\r\n\r\n"
+        b"5\r\nhello\r\n0\r\n\r\n"
+    )
+    resp = s.recv(65536)
+    assert resp.split(b"\r\n", 1)[0].endswith(b"501 Not Implemented"), resp[:80]
+    s.close()
+
+    # oversized Content-Length: 413, connection closed, nothing re-parsed
+    s = sk.create_connection(("127.0.0.1", 8081), timeout=5)
+    s.sendall(
+        b"POST /auth_request HTTP/1.1\r\nHost: localhost:8081\r\n"
+        b"Content-Length: 99999999999\r\n\r\n"
+    )
+    resp = s.recv(65536)
+    assert b"413" in resp.split(b"\r\n", 1)[0], resp[:80]
+    s.close()
+
+    # HEAD on the hot route: headers only, Content-Length present, no body
+    s = sk.create_connection(("127.0.0.1", 8081), timeout=5)
+    s.sendall(
+        b"HEAD /auth_request?path=/ HTTP/1.1\r\nHost: localhost:8081\r\n"
+        b"X-Client-IP: 42.42.42.10\r\n\r\n"
+    )
+    time.sleep(0.3)
+    resp = s.recv(65536)
+    head, _, tail = resp.partition(b"\r\n\r\n")
+    assert b"content-length" in head.lower(), head
+    assert tail == b"", f"HEAD response leaked {len(tail)} body bytes"
     s.close()
 
     # POST body present and consumed (route ignores it; must not desync

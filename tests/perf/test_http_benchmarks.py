@@ -15,7 +15,6 @@ import random
 import time
 
 import pytest
-import requests
 
 BASE = "http://localhost:8081"
 
@@ -86,21 +85,33 @@ def _rand_ip(rng):
     return f"{rng.randint(1, 251)}.{rng.randint(0, 255)}.{rng.randint(0, 255)}.{rng.randint(1, 254)}"
 
 
+def _serial_get(conn, path, ip):
+    """One request over a kept-alive http.client connection — the closest
+    Python analogue of the reference harness's Go http.Client (~50 us of
+    client cost, vs python-requests' ~1 ms which hid the server behind
+    the client on a shared core)."""
+    conn.request("GET", path, headers={"X-Client-IP": ip})
+    r = conn.getresponse()
+    r.read()
+    return r.status
+
+
 def test_benchmark_auth_request(app):
     """BenchmarkAuthRequest (banjax_performance_test.go:18-31): sustained
-    GET /auth_request with a random X-Client-IP per request."""
+    serial GET /auth_request with a random X-Client-IP per request."""
+    import http.client
+
     rng = random.Random(9)
-    s = requests.Session()
+    conn = http.client.HTTPConnection("localhost", 8081, timeout=5)
     for _ in range(20):  # warm
-        s.get(f"{BASE}/auth_request",
-              headers={"X-Client-IP": _rand_ip(rng)}, timeout=5)
-    n = 300
+        _serial_get(conn, "/auth_request", _rand_ip(rng))
+    n = 600
     t0 = time.perf_counter()
     for _ in range(n):
-        r = s.get(f"{BASE}/auth_request",
-                  headers={"X-Client-IP": _rand_ip(rng)}, timeout=5)
-        assert r.status_code in (200, 429, 403)
+        status = _serial_get(conn, "/auth_request", _rand_ip(rng))
+        assert status in (200, 429, 403)
     rps = n / (time.perf_counter() - t0)
+    conn.close()
     print(json.dumps({"benchmark": "auth_request", "rps": round(rps, 1)}))
     assert rps >= AUTH_FLOOR_RPS
 
@@ -116,18 +127,21 @@ def test_benchmark_protected_paths(app):
         "/wp-admin/admin-ajax.php?a=1", "/wp-admin/admin-ajax.php?a=1&b=2",
         "/wp-admin/admin-ajax.php#test", "wp-admin/admin-ajax.php/",
     ]
-    s = requests.Session()
-    for p in paths:  # warm
-        s.get(f"{BASE}/auth_request", params={"path": p},
-              headers={"X-Client-IP": _rand_ip(rng)}, timeout=5)
-    iters = 25
+    import http.client
+    from urllib.parse import quote
+
+    conn = http.client.HTTPConnection("localhost", 8081, timeout=5)
+    targets = [f"/auth_request?path={quote(p, safe='')}" for p in paths]
+    for t in targets:  # warm
+        _serial_get(conn, t, _rand_ip(rng))
+    iters = 40
     t0 = time.perf_counter()
     for _ in range(iters):
-        for p in paths:
-            r = s.get(f"{BASE}/auth_request", params={"path": p},
-                      headers={"X-Client-IP": _rand_ip(rng)}, timeout=5)
-            assert r.status_code in (200, 401, 429)
+        for t in targets:
+            status = _serial_get(conn, t, _rand_ip(rng))
+            assert status in (200, 401, 429)
     rps = iters * len(paths) / (time.perf_counter() - t0)
+    conn.close()
     print(json.dumps({"benchmark": "protected_paths", "rps": round(rps, 1)}))
     assert rps >= PROTECTED_FLOOR_RPS
 
