@@ -140,6 +140,7 @@ class BanjaxApp:
             metrics_path, self.dynamic_lists, RegexStatesView(self),
             self.failed_challenge_states,
             matcher_getter=lambda: self._matcher,
+            supervisor_getter=lambda: self._supervisor,
         )
 
         gin_log_name = "gin.log" if config.standalone_testing else config.gin_log_file

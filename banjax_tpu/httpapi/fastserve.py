@@ -274,7 +274,14 @@ class FastHttpProtocol(asyncio.Protocol):
             headers = {}
             for hl in hdr_lines:
                 k, _, v = hl.partition(":")
-                headers[k.strip().lower()] = v.strip()
+                k = k.strip().lower()
+                if k == "content-length" and k in headers \
+                        and headers[k] != v.strip():
+                    # conflicting Content-Length values: reject (RFC 7230)
+                    # — last-wins would reopen the body-smuggling desync
+                    # the Transfer-Encoding guard below closes
+                    raise ValueError("conflicting content-length")
+                headers[k] = v.strip()
         except ValueError:
             self.write(serialize_response(
                 Response(status=400, body=b"bad request"), False))

@@ -321,7 +321,19 @@ class RemoteBanner(BannerInterface):
 
 
 class PrimarySupervisor:
-    """Owns worker subprocesses + the control plane, from the primary."""
+    """Owns worker subprocesses + the control plane, from the primary.
+
+    A monitor thread respawns any worker that dies (crash, OOM-kill) with
+    exponential backoff per worker slot — the serving capacity heals
+    instead of silently degrading.  A respawned worker rebuilds its
+    decision-list replica from the primary's broadcasts going forward;
+    stale entries it missed while down converge via the next reload or
+    expire on their TTLs (monotonic-severity updates make the partial
+    window safe: it can only under-block briefly, exactly like the
+    reference restarting)."""
+
+    RESPAWN_BACKOFF_S = (1.0, 2.0, 4.0, 8.0, 16.0)
+    MONITOR_INTERVAL_S = 1.0
 
     def __init__(self, app, ctrl_dir: str, n_workers: int) -> None:
         self.ctrl_dir = ctrl_dir
@@ -329,47 +341,90 @@ class PrimarySupervisor:
         self.control = ControlPlane(ctrl_dir, app)
         self._app = app
         self._procs: List[subprocess.Popen] = []
+        self._respawns = [0] * n_workers
+        self._next_spawn_ok = [0.0] * n_workers
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
 
     def primary_http_sock(self) -> str:
         return os.path.join(self.ctrl_dir, PRIMARY_HTTP_SOCK)
 
-    def spawn_workers(self) -> None:
+    def _spawn_one(self, index: int) -> subprocess.Popen:
         config = self._app.config_holder.get()
+        cmd = [
+            sys.executable, "-m", "banjax_tpu.httpapi.worker_serve",
+            "-config-file", self._app.config_holder.path,
+            "-ctrl-dir", self.ctrl_dir,
+            "-index", str(index),
+            "-shm-name", self._app.failed_challenge_states.name,
+        ]
+        if config.standalone_testing:
+            cmd.append("-standalone-testing")
+        if config.debug:
+            cmd.append("-debug")
+        env = dict(os.environ)
+        # workers never touch jax; keep their footprint host-only
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # the package may be run from a source tree (not installed):
+        # make sure the worker can import banjax_tpu
+        import banjax_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(banjax_tpu.__file__))
+        parts = [pkg_root] + (
+            env.get("PYTHONPATH", "").split(os.pathsep)
+            if env.get("PYTHONPATH") else []
+        )
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
+        return subprocess.Popen(cmd, env=env)
+
+    def spawn_workers(self) -> None:
         for i in range(self.n_workers):
             self.control.add_worker(i)
-            cmd = [
-                sys.executable, "-m", "banjax_tpu.httpapi.worker_serve",
-                "-config-file", self._app.config_holder.path,
-                "-ctrl-dir", self.ctrl_dir,
-                "-index", str(i),
-                "-shm-name", self._app.failed_challenge_states.name,
-            ]
-            if config.standalone_testing:
-                cmd.append("-standalone-testing")
-            if config.debug:
-                cmd.append("-debug")
-            env = dict(os.environ)
-            # workers never touch jax; keep their footprint host-only
-            env.setdefault("JAX_PLATFORMS", "cpu")
-            # the package may be run from a source tree (not installed):
-            # make sure the worker can import banjax_tpu
-            import banjax_tpu
-
-            pkg_root = os.path.dirname(os.path.dirname(banjax_tpu.__file__))
-            parts = [pkg_root] + (
-                env.get("PYTHONPATH", "").split(os.pathsep)
-                if env.get("PYTHONPATH") else []
-            )
-            env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(parts))
-            proc = subprocess.Popen(cmd, env=env)
-            self._procs.append(proc)
+            self._procs.append(self._spawn_one(i))
         self.control.start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="worker-monitor", daemon=True
+        )
+        self._monitor.start()
         log.info("spawned %d http workers (ctrl %s)", self.n_workers, self.ctrl_dir)
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.MONITOR_INTERVAL_S):
+            for i, proc in enumerate(self._procs):
+                try:
+                    if proc.poll() is None:
+                        continue
+                    now = time.monotonic()
+                    if now < self._next_spawn_ok[i]:
+                        continue
+                    n = self._respawns[i]
+                    backoff = self.RESPAWN_BACKOFF_S[
+                        min(n, len(self.RESPAWN_BACKOFF_S) - 1)
+                    ]
+                    self._next_spawn_ok[i] = now + backoff
+                    self._respawns[i] = n + 1
+                    log.warning(
+                        "http worker %d exited rc=%s — respawning (attempt "
+                        "%d, next backoff %.0fs)",
+                        i, proc.returncode, n + 1, backoff,
+                    )
+                    self._procs[i] = self._spawn_one(i)
+                except Exception as e:  # noqa: BLE001 — a failed spawn
+                    # (fork EAGAIN under memory pressure) must not kill the
+                    # monitor; the slot retries after its backoff
+                    log.error("worker %d respawn failed: %s", i, e)
+
+    @property
+    def respawn_count(self) -> int:
+        return sum(self._respawns)
 
     def broadcast_reload(self) -> None:
         self.control.broadcast({"op": "reload"})
 
     def stop(self) -> None:
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=3)
         for p in self._procs:
             p.terminate()
         for p in self._procs:

@@ -33,6 +33,7 @@ def write_metrics_line(
     regex_states: RegexRateLimitStates,
     failed_challenge_states: FailedChallengeRateLimitStates,
     matcher=None,
+    supervisor=None,
 ) -> None:
     challenges, blocks = dynamic_lists.metrics()
     line = {
@@ -48,6 +49,14 @@ def write_metrics_line(
                 getattr(matcher, "device_windows", None), matcher
             )
         )
+    if supervisor is not None:
+        # multi-worker serving health: nonzero respawns = workers crashed
+        # and were healed (httpapi/workers.py monitor)
+        line["HttpWorkers"] = supervisor.n_workers
+        line["HttpWorkerRespawns"] = supervisor.respawn_count
+        line["HttpFcDropped"] = getattr(
+            failed_challenge_states, "dropped", 0
+        )
     out.write(json.dumps(line) + "\n")
     out.flush()
 
@@ -61,6 +70,7 @@ class MetricsReporter:
         failed_challenge_states: FailedChallengeRateLimitStates,
         interval_seconds: float = REPORT_INTERVAL_SECONDS,
         matcher_getter: Optional[Callable[[], object]] = None,
+        supervisor_getter: Optional[Callable[[], object]] = None,
     ):
         self.log_path = log_path
         self.dynamic_lists = dynamic_lists
@@ -69,6 +79,7 @@ class MetricsReporter:
         self.interval_seconds = interval_seconds
         # a getter, not the matcher itself: SIGHUP reload swaps the matcher
         self.matcher_getter = matcher_getter
+        self.supervisor_getter = supervisor_getter
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -85,7 +96,10 @@ class MetricsReporter:
         with open(self.log_path, "w", encoding="utf-8") as out:
             while not self._stop.wait(self.interval_seconds):
                 matcher = self.matcher_getter() if self.matcher_getter else None
+                supervisor = (
+                    self.supervisor_getter() if self.supervisor_getter else None
+                )
                 write_metrics_line(
                     out, self.dynamic_lists, self.regex_states,
-                    self.failed_challenge_states, matcher,
+                    self.failed_challenge_states, matcher, supervisor,
                 )

@@ -227,8 +227,11 @@ def _save_section(name: str, backend: str, data: dict) -> None:
     one; TPU overwrites TPU (newer code wins); CPU overwrites CPU."""
     p = _load_partial()
     prev = p["sections"].get(name)
-    # 'meta' is bookkeeping (skip lists), not evidence — always refresh it.
-    if name != "meta" and prev and prev.get("backend") == "tpu" and backend != "tpu":
+    # 'meta' is bookkeeping (skip lists) and 'http' never touches the
+    # device — neither is chip evidence, so newest always wins for them
+    # (also migrates any http row a pre-fix tpu worker mislabeled).
+    if (name not in ("meta", "http") and prev
+            and prev.get("backend") == "tpu" and backend != "tpu"):
         return
     p["sections"][name] = {
         "backend": backend,
@@ -758,7 +761,9 @@ def worker_main(backend: str, budget_s: float, only: "list | None") -> None:
             # survive a Mosaic lowering reject later in the same section)
             data["error"] = f"{type(exc).__name__}: {exc}"
         data["section_elapsed_s"] = round(time.monotonic() - deadline.t0, 1)
-        _save_section(name, actual, data)
+        # the http section never touches the device: label it cpu always,
+        # so a tpu-worker run can't freeze it under the best-evidence rule
+        _save_section(name, "cpu" if name == "http" else actual, data)
         print(f"[bench-worker] {name} done on {actual}", file=sys.stderr)
     if deadline.skipped:
         _save_section(

@@ -161,6 +161,16 @@ def test_fastserve_bad_requests(app_factory, tmp_path):
     assert resp.split(b"\r\n", 1)[0].endswith(b"501 Not Implemented"), resp[:80]
     s.close()
 
+    # conflicting Content-Length values: 400 (RFC 7230), no last-wins
+    s = sk.create_connection(("127.0.0.1", 8081), timeout=5)
+    s.sendall(
+        b"POST /auth_request HTTP/1.1\r\nHost: localhost:8081\r\n"
+        b"Content-Length: 0\r\nContent-Length: 5\r\n\r\nhello"
+    )
+    resp = s.recv(65536)
+    assert b"400" in resp.split(b"\r\n", 1)[0], resp[:80]
+    s.close()
+
     # oversized Content-Length: 413, connection closed, nothing re-parsed
     s = sk.create_connection(("127.0.0.1", 8081), timeout=5)
     s.sendall(
