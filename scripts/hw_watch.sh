@@ -2,24 +2,30 @@
 # Poll for the axon TPU tunnel; the moment a probe succeeds, run the full
 # hardware bench session (scripts/hw_session.sh) exactly once.
 #
-# Probe = `jax.devices()` in a subprocess with a hard timeout: when the
-# tunnel is down, backend init blocks forever, so a short timeout is the
-# only reliable liveness signal.  Logs to scripts/hw_watch.log.
+# Two-stage probe: a cheap TCP connect to the relay ports (8082/8083 —
+# closed whenever the tunnel is down) every 20 s, then a real
+# `jax.devices()` in a subprocess with a hard timeout (backend init blocks
+# forever when the relay half-answers, so the timeout is the only reliable
+# liveness signal).  Logs to scripts/hw_watch.log.
 cd "$(dirname "$0")/.."
 LOG=scripts/hw_watch.log
 echo "[hw_watch] start $(date -u +%FT%TZ)" >> "$LOG"
 while true; do
-  if timeout 60 python -c "
+  if timeout 3 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8082' 2>/dev/null \
+     || timeout 3 bash -c 'exec 3<>/dev/tcp/127.0.0.1/8083' 2>/dev/null; then
+    echo "[hw_watch] relay port open $(date -u +%FT%TZ) — jax probe" >> "$LOG"
+    if timeout 120 python -c "
 import jax
 ds = jax.devices()
 assert any(d.platform == 'tpu' for d in ds), ds
 print('tpu up:', ds)
 " >> "$LOG" 2>&1; then
-    echo "[hw_watch] TPU answered $(date -u +%FT%TZ) — running session" >> "$LOG"
-    bash scripts/hw_session.sh >> scripts/hw_session.log 2>&1
-    echo "[hw_watch] session done rc=$? $(date -u +%FT%TZ)" >> "$LOG"
-    exit 0
+      echo "[hw_watch] TPU answered $(date -u +%FT%TZ) — running session" >> "$LOG"
+      bash scripts/hw_session.sh >> scripts/hw_session.log 2>&1
+      echo "[hw_watch] session done rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+      exit 0
+    fi
+    echo "[hw_watch] port open but jax probe failed $(date -u +%FT%TZ)" >> "$LOG"
   fi
-  echo "[hw_watch] probe failed $(date -u +%FT%TZ); retry in 90s" >> "$LOG"
-  sleep 90
+  sleep 20
 done
