@@ -18,6 +18,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 import signal
 import sys
 import threading
@@ -88,7 +89,15 @@ class BanjaxApp:
 
         self.regex_states = RegexRateLimitStates()
         self._supervisor = None  # multi-worker serving (httpapi/workers.py)
-        n_http_workers = max(0, config.http_workers)
+        n_http_workers = config.http_workers
+        if n_http_workers == -1:  # auto: one worker per extra core
+            n_http_workers = max(0, (os.cpu_count() or 1) - 1)
+        elif n_http_workers < -1:
+            log.warning(
+                "http_workers=%d is out of range (only -1 means auto); "
+                "serving single-process", n_http_workers,
+            )
+            n_http_workers = 0
         if n_http_workers > 0:
             from banjax_tpu.native import shm as native_shm
 

@@ -181,8 +181,9 @@ class Config:
     # (httpapi/workers.py). 0 = single process, the reference's layout;
     # N > 0 spawns N workers sharing 127.0.0.1:8081 with the primary,
     # with the failed-challenge limiter in native shared memory and
-    # side effects forwarded to the primary. Needs a C compiler at
-    # first start (native/shmstate.c); falls back to 0 without one.
+    # side effects forwarded to the primary; -1 = auto (cores - 1,
+    # which is 0 on a single-core host). Needs a C compiler at first
+    # start (native/shmstate.c); falls back to 0 without one.
     http_workers: int = 0
     # native asyncio-protocol server for the /auth_request hot path
     # (httpapi/fastserve.py): ~2-3x the aiohttp requests/sec, identical

@@ -53,7 +53,9 @@ _HEADERS_COMPARED = (
 )
 
 
-def _capture(app_factory, tmp_path, fast: bool, tag: str):
+def _start_layout(app_factory, tmp_path, fast: bool, tag: str):
+    """Boot the app with http_fast_path toggled (shared by both
+    differential tests so the bootstrap/settle protocol cannot drift)."""
     cfg = tmp_path / f"cfg-{tag}.yaml"
     cfg.write_text(
         (_FIXTURES / "banjax-config-test.yaml").read_text()
@@ -61,6 +63,11 @@ def _capture(app_factory, tmp_path, fast: bool, tag: str):
     )
     app = app_factory(str(cfg))
     time.sleep(0.5)
+    return app
+
+
+def _capture(app_factory, tmp_path, fast: bool, tag: str):
+    app = _start_layout(app_factory, tmp_path, fast, tag)
     rows = []
     for method, path, headers, cookies, data in CORPUS:
         headers = dict(headers, Host="localhost:8081")
@@ -103,6 +110,101 @@ def test_fastserve_matches_aiohttp_wire_contract(app_factory, tmp_path):
             # bodies are config-deterministic (challenge/password pages,
             # empty bodies); dynamic-route bodies may embed timestamps
             assert s["body"] == f["body"], (ctx, s["body_len"], f["body_len"])
+
+
+def _random_requests(seed: int, n: int):
+    """Reproducible randomized request corpus: methods, hot/cold paths,
+    header casing, query encodings, cookie values (valid + invalid
+    escapes)."""
+    import random as _random
+    from urllib.parse import quote
+
+    rng = _random.Random(seed)
+    methods = ["GET", "GET", "GET", "POST", "HEAD"]
+    paths = [
+        "/auth_request", "/info", "/is_banned", "/banned",
+        "/rate_limit_states", "/decision_lists", "/nope",
+    ]
+    query_paths = [
+        "/", "wp-admin/x", "/wp-admin//", "wp-admin/admin-ajax.php",
+        "a b", "/x?y=1&z=2", "ünïcode/päth", "%2e%2e/etc", "", "/" * 40,
+    ]
+    cookie_vals = [
+        "garbage", "a%2Bb", "bad%zz", "", "x" * 120, "sp ace",
+    ]
+    out = []
+    for i in range(n):
+        method = rng.choice(methods)
+        path = rng.choice(paths)
+        target = path
+        if path == "/auth_request":
+            target += "?path=" + quote(rng.choice(query_paths), safe="")
+        elif path == "/is_banned" and rng.random() < 0.8:
+            target += f"?ip=10.9.{i % 250}.1"
+        elif path == "/banned" and rng.random() < 0.8:
+            target += "?domain=example.com"
+        headers = {}
+        ip_hdr = rng.choice(["X-Client-IP", "x-client-ip", "X-CLIENT-IP"])
+        headers[ip_hdr] = f"10.8.{i % 250}.{rng.randint(1, 250)}"
+        if rng.random() < 0.5:
+            headers["X-Client-User-Agent"] = rng.choice(
+                ["mozilla", "sqlmap/1.7", ""]
+            )
+        cookies = {}
+        if rng.random() < 0.5:
+            cookies[rng.choice(["deflect_password3", "deflect_session",
+                                "other"])] = rng.choice(cookie_vals)
+        out.append((method, target, headers, cookies))
+    return out
+
+
+def _drive_corpus(corpus):
+    import http.client
+
+    conn = http.client.HTTPConnection("localhost", 8081, timeout=5)
+    rows = []
+    for method, target, headers, cookies in corpus:
+        hdrs = dict(headers, Host="localhost:8081")
+        if cookies:
+            hdrs["Cookie"] = "; ".join(f"{k}={v}" for k, v in cookies.items())
+        conn.request(method, target, headers=hdrs)
+        r = conn.getresponse()
+        body = r.read()
+        cookie_shapes = sorted(
+            (v.split("=", 1)[0],
+             tuple(sorted(a.strip().split("=", 1)[0].lower()
+                          for a in v.split(";")[1:])))
+            for k, v in r.getheaders() if k.lower() == "set-cookie"
+        )
+        rows.append({
+            "req": (method, target),
+            "status": r.status,
+            "ct": r.getheader("Content-Type"),
+            "decision": r.getheader("X-Banjax-Decision"),
+            "accel": r.getheader("X-Accel-Redirect"),
+            "cookies": cookie_shapes,
+            "body_len": len(body),
+        })
+    conn.close()
+    return rows
+
+
+def test_fastserve_generative_differential(app_factory, tmp_path):
+    """Randomized request fuzz: the two layouts must agree on status,
+    content type, decision headers, and cookie shapes for every request
+    in a reproducible 60-case random corpus."""
+    corpus = _random_requests(seed=17, n=60)
+
+    def run(fast, tag):
+        app = _start_layout(app_factory, tmp_path, fast, f"g{tag}")
+        rows = _drive_corpus(corpus)
+        app.stop_background()
+        return rows
+
+    slow = run(False, "aio")
+    fast = run(True, "fast")
+    for s, f in zip(slow, fast):
+        assert s == f, (s, f)
 
 
 def test_fastserve_handles_fragmented_and_pipelined_requests(app_factory, tmp_path):

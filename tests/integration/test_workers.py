@@ -245,3 +245,22 @@ def test_workers_soak_load_reload_kill(workers_app, tmp_path):
     r = requests.get(f"{BASE}/rate_limit_states", timeout=5)
     assert r.status_code == 200
     assert _auth("/", "32.32.32.1").status_code == 200
+
+
+def test_http_workers_auto_on_single_core(app_factory, tmp_path):
+    """http_workers: -1 resolves to cores-1 (0 on this 1-core box — the
+    single-process layout, no supervisor)."""
+    custom = tmp_path / "banjax-config-auto.yaml"
+    custom.write_text(
+        (_FIXTURES / "banjax-config-test.yaml").read_text()
+        + "\nhttp_workers: -1\n"
+    )
+    app = app_factory(str(custom))
+    expected = max(0, (os.cpu_count() or 1) - 1)
+    if expected == 0:
+        assert app._supervisor is None
+    else:
+        assert app._supervisor is not None
+        assert app._supervisor.n_workers == expected
+    r = requests.get(f"{BASE}/info", timeout=5)
+    assert r.status_code == 200
