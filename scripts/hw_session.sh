@@ -64,3 +64,13 @@ BENCH_SECTIONS=single_stage,fused BENCH_BUDGET_S=600 timeout 900 python bench.py
 # 3. e2e + mesh + ladder
 BENCH_SECTIONS=e2e,mesh BENCH_BUDGET_S=600 timeout 900 python bench.py
 BENCH_SECTIONS=ladder BENCH_BUDGET_S=900 timeout 1200 python bench.py
+
+# 4. bounded tiling/batch sweep (per-config JSON lines to the session log;
+# the headline sections above are already banked, so a wedge here costs
+# nothing)
+timeout 900 python scripts/hw_sweep.py 600 || true
+
+# 5. re-bank the two headline sections (tpu rows overwrite tpu rows,
+# newest wins; a re-run with warm compile caches is usually the cleaner
+# number)
+BENCH_SECTIONS=single_stage,fused BENCH_BUDGET_S=480 timeout 700 python bench.py
