@@ -18,17 +18,17 @@ import pytest
 
 BASE = "http://localhost:8081"
 
-# requests/sec floors on a 1-core CI box driving via python-requests (the
-# client itself costs ~1 ms/req; the reference's Go harness records nothing
-# to compare against, so the floor only guards OUR regressions)
-AUTH_FLOOR_RPS = 150
-PROTECTED_FLOOR_RPS = 150
-# server-capacity floor: concurrent raw-socket keepalive client, which
-# costs ~30 us/req instead of requests' ~1 ms — this is the number
-# comparable to driving the reference's Go server with its Go client.
-# fastserve measures 5.6-7.6k on the 1-core build box (client sharing the
-# core); 2k still fails on any fast-path regression while leaving ~3x for
-# CI noise
+# serial requests/sec floors on a 1-core CI box driving via http.client
+# keepalive (~3-4.5k measured; the reference's Go harness records nothing
+# to compare against, so the floors only guard OUR regressions — set at
+# ~1/4 of measured for full-suite/CI-box pressure)
+AUTH_FLOOR_RPS = 800
+PROTECTED_FLOOR_RPS = 700
+# server-capacity floor: concurrent raw-socket keepalive client (~30
+# us/req of client cost) — the number comparable to driving the
+# reference's Go server with its Go client. fastserve measures 5.6-7.6k
+# on the 1-core build box (client sharing the core); 2k still fails on
+# any fast-path regression while leaving ~3x for CI noise
 CAPACITY_FLOOR_RPS = 2_000
 
 
@@ -56,7 +56,8 @@ async def _capacity_worker(n: int, results: list, rand_ip) -> None:
 def measure_capacity(n_per_conn: int = 400, conc: int = 16,
                      seed: int = 11) -> float:
     """Sustained /auth_request throughput with a cheap concurrent client
-    (the serial python-requests harnesses above are client-bound)."""
+    (the serial http.client mirrors above measure latency, not
+    capacity)."""
     rng = random.Random(seed)
 
     def rand_ip():
