@@ -26,7 +26,7 @@ import asyncio
 import json
 import logging
 import time
-from typing import Optional, Tuple
+from typing import Optional
 from urllib.parse import parse_qs
 
 from banjax_tpu.httpapi.decision_chain import (

@@ -1,0 +1,63 @@
+"""Direct unit coverage for fastserve's serializer and parser helpers
+(the wire behavior is covered end-to-end by the integration differential;
+these pin the units for debuggability)."""
+
+from banjax_tpu.httpapi.decision_chain import Response, SetCookie
+from banjax_tpu.httpapi.fastserve import _ParsedRequest, serialize_response
+
+
+def test_serialize_basic():
+    raw = serialize_response(
+        Response(status=200, body=b"hi", content_type="text/plain",
+                 headers={"X-Banjax-Decision": "NoMention"}),
+        keep_alive=True,
+    )
+    head, _, body = raw.partition(b"\r\n\r\n")
+    assert head.startswith(b"HTTP/1.1 200 OK\r\n")
+    assert b"Content-Type: text/plain\r\n" in head + b"\r\n"
+    assert b"Content-Length: 2" in head
+    assert b"X-Banjax-Decision: NoMention" in head
+    assert b"Connection: keep-alive" in head
+    assert body == b"hi"
+
+
+def test_serialize_cookie_attributes_and_escaping():
+    raw = serialize_response(
+        Response(cookies=[SetCookie(
+            name="deflect_session", value="a+b/c=", max_age=3600,
+            path="/", domain="example.com", secure=True, http_only=True,
+        )]),
+        keep_alive=False,
+    )
+    line = [l for l in raw.split(b"\r\n") if l.startswith(b"Set-Cookie")][0]
+    # gin QueryEscape of the value, then the attribute set
+    assert line == (
+        b"Set-Cookie: deflect_session=a%2Bb%2Fc%3D; Max-Age=3600; "
+        b"Domain=example.com; Path=/; Secure; HttpOnly"
+    )
+    assert b"Connection: close" in raw
+
+
+def test_serialize_head_only_keeps_length_drops_body():
+    raw = serialize_response(
+        Response(status=200, body=b"x" * 37), keep_alive=True, head_only=True
+    )
+    assert b"Content-Length: 37" in raw
+    assert raw.endswith(b"\r\n\r\n")
+
+
+def test_parsed_request_query_param_percent_decoding():
+    req = _ParsedRequest(
+        "GET", "/auth_request", "path=%2Fwp-admin%2Fx&y=a+b",
+        {"host": "h"}, b"", True, b"",
+    )
+    assert req.query_param("path") == "/wp-admin/x"
+    assert req.query_param("y") == "a b"
+    assert req.query_param("absent") == ""
+
+
+def test_parsed_request_header_lookup():
+    req = _ParsedRequest("GET", "/", "", {"x-client-ip": "1.2.3.4"},
+                         b"", True, b"")
+    assert req.header("x-client-ip") == "1.2.3.4"
+    assert req.header("missing") == ""

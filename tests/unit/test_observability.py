@@ -147,3 +147,27 @@ def test_pprof_endpoints_respond():
             await client.close()
 
     asyncio.run(drive())
+
+
+def test_supervisor_keys_are_additive():
+    """Multi-worker serving health keys appear only when a supervisor is
+    passed (the reference schema stays untouched otherwise)."""
+    import types
+
+    sup = types.SimpleNamespace(n_workers=2, respawn_count=3)
+    out = io.StringIO()
+    write_metrics_line(
+        out,
+        DynamicDecisionLists(start_sweeper=False),
+        RegexRateLimitStates(),
+        FailedChallengeRateLimitStates(),
+        None,
+        sup,
+    )
+    line = json.loads(out.getvalue())
+    assert set(line) == REFERENCE_KEYS | {
+        "HttpWorkers", "HttpWorkerRespawns", "HttpFcDropped",
+    }
+    assert line["HttpWorkers"] == 2
+    assert line["HttpWorkerRespawns"] == 3
+    assert line["HttpFcDropped"] == 0  # python limiter has no drop counter
