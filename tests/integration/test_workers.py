@@ -264,3 +264,37 @@ def test_http_workers_auto_on_single_core(app_factory, tmp_path):
         assert app._supervisor.n_workers == expected
     r = requests.get(f"{BASE}/info", timeout=5)
     assert r.status_code == 200
+
+
+def test_workers_with_fast_path_disabled(app_factory, tmp_path):
+    """The aiohttp worker layout (http_fast_path: false + workers) still
+    serves hot and cold routes with shared fc counting — the pre-fastserve
+    topology must not rot while it remains configurable."""
+    custom = tmp_path / "banjax-config-aio-workers.yaml"
+    custom.write_text(
+        (_FIXTURES / "banjax-config-test.yaml").read_text()
+        + "\nhttp_workers: 2\nhttp_fast_path: false\n"
+    )
+    app = app_factory(str(custom))
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if all(p.poll() is None for p in app._supervisor._procs):
+            try:
+                requests.get(f"{BASE}/info", timeout=2)
+                break
+            except requests.RequestException:
+                pass
+        time.sleep(0.2)
+    time.sleep(1.0)
+    assert all(p.poll() is None for p in app._supervisor._procs)
+
+    ip = "26.26.26.26"
+    statuses = [
+        _auth("wp-admin/x", ip, {"deflect_password3": "garbage"}).status_code
+        for _ in range(3)
+    ]
+    assert statuses == [401] * 3
+    r = requests.get(f"{BASE}/rate_limit_states", timeout=5)
+    assert r.status_code == 200 and f"{ip},: interval_start: " in r.text
+    r = requests.get(f"{BASE}/is_banned", params={"ip": ip}, timeout=5)
+    assert r.status_code == 200
