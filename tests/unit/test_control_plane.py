@@ -91,3 +91,53 @@ def test_monotonic_severity_survives_echo():
         assert ok and got.decision == Decision.IPTABLES_BLOCK
     finally:
         w.close()
+
+
+def test_worker_control_survives_garbage_datagrams(tmp_path):
+    """Bad broadcasts (not JSON, wrong fields, unknown ops) must never
+    kill a worker's control thread — the replica keeps applying
+    subsequent valid deltas."""
+    import json
+    import socket
+
+    replica = DynamicDecisionLists(start_sweeper=False)
+    ctrl = WorkerControl(str(tmp_path), 0, replica, on_reload=lambda: None)
+    try:
+        send = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+        path = f"{tmp_path}/worker-0.sock"
+        for payload in (b"not json", b"{}", b'{"op": "wat"}',
+                        b'{"op": "dyn_update"}',  # missing fields
+                        b'{"op": "dyn_update", "ip": 5, "expires": "x", '
+                        b'"decision": 99, "from_baskerville": 0, "domain": 1}'):
+            send.sendto(payload, path)
+        good = {
+            "op": "dyn_update", "ip": "6.6.6.6",
+            "expires": time.time() + 60, "decision": int(Decision.CHALLENGE),
+            "from_baskerville": False, "domain": "d",
+        }
+        send.sendto(json.dumps(good).encode(), path)
+        deadline = time.time() + 5
+        ok = False
+        while time.time() < deadline:
+            _, ok = replica.check("", "6.6.6.6")
+            if ok:
+                break
+            time.sleep(0.05)
+        assert ok, "valid delta not applied after garbage datagrams"
+        send.close()
+    finally:
+        ctrl.stop()
+        replica.close()
+
+
+def test_control_plane_send_to_dead_socket_drops_silently(tmp_path):
+    """_send_json to an absent peer must drop, not raise (the kafka
+    drop-don't-block discipline)."""
+    import socket
+
+    from banjax_tpu.httpapi.workers import _send_json
+
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+    s.setblocking(False)
+    _send_json(s, f"{tmp_path}/nonexistent.sock", {"op": "dyn_clear"})
+    s.close()
