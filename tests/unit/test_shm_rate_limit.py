@@ -164,3 +164,45 @@ def test_multiprocess_counting_exact():
     finally:
         table.close()
         table.unlink()
+
+
+def test_overlong_keys_truncate_consistently():
+    """Keys beyond the 104-byte slot field truncate; the SAME overlong
+    key keeps counting as one stream (truncation is deterministic), and
+    the python-limiter differential only applies to in-range keys (real
+    IPs are <= 45 chars)."""
+    cfg = _cfg(interval_s=60, threshold=3)
+    table = shm.ShmFailedChallengeStates(capacity=64)
+    try:
+        long_key = "x" * 300
+        r1 = table.apply(long_key, cfg)
+        r2 = table.apply(long_key, cfg)
+        assert r1.match_type.name == "FIRST_TIME"
+        assert r2.match_type.name == "INSIDE_INTERVAL"
+        # a different key sharing the first 104 bytes intentionally maps
+        # to the same counter (documented truncation)
+        r3 = table.apply("x" * 104 + "DIFFERENT", cfg)
+        assert r3.match_type.name == "INSIDE_INTERVAL"
+        assert len(table) == 1
+    finally:
+        table.close()
+        table.unlink()
+
+
+def test_empty_ip_counts_like_python_limiter():
+    """The zero-length-key sentinel: '' must accumulate (and exceed) like
+    the python limiter, not reset every time (shmstate.c marks empty
+    slots with key_len 0, so '' maps to a one-NUL sentinel)."""
+    cfg = _cfg(interval_s=60, threshold=2)
+    table = shm.ShmFailedChallengeStates(capacity=64)
+    py = FailedChallengeRateLimitStates()
+    try:
+        for _ in range(6):
+            a = table.apply("", cfg)
+            b = py.apply("", cfg)
+            assert (a.match_type, a.exceeded) == (b.match_type, b.exceeded)
+        # introspection shows the empty key, not the sentinel byte
+        assert table.format_states().startswith(",: interval_start: ")
+    finally:
+        table.close()
+        table.unlink()
