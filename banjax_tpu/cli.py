@@ -41,18 +41,25 @@ from banjax_tpu.ingest.reports import report_status_message
 from banjax_tpu.ingest.tailer import LogTailer
 from banjax_tpu.matcher.cpu_ref import CpuMatcher
 from banjax_tpu.obs.metrics import MetricsReporter
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.resilience.health import HealthRegistry
 
 log = logging.getLogger(__name__)
 
 KAFKA_STATUS_INTERVAL_SECONDS = 19  # banjax.go:204
 
 
-def build_matcher(config, banner, static_lists, regex_states):
+def build_matcher(config, banner, static_lists, regex_states, health=None):
     """The Matcher seam flag (BASELINE.json): cpu (default) or tpu."""
     if config.matcher == "tpu":
         from banjax_tpu.matcher.runner import TpuMatcher
 
-        return TpuMatcher(config, banner, static_lists, regex_states)
+        return TpuMatcher(config, banner, static_lists, regex_states,
+                          health=health)
+    if health is not None:
+        # the CPU matcher has no device to fail; register it so /healthz
+        # still lists the component
+        health.register("matcher")
     return CpuMatcher(config, banner, static_lists, regex_states)
 
 
@@ -86,6 +93,14 @@ class BanjaxApp:
         log.info("INIT: config file: %s", config_file)
         self.config_holder = ConfigHolder(config_file, standalone_testing, debug)
         config = self.config_holder.get()
+
+        # component health registry (resilience/health.py): every long-
+        # lived loop below registers itself; /healthz and the metrics line
+        # read the aggregate.  Per-app (not global) so in-process tests
+        # don't cross-contaminate.
+        self.health = HealthRegistry()
+        if getattr(config, "failpoints", ""):
+            failpoints.arm_from_spec(config.failpoints)
 
         self.regex_states = RegexRateLimitStates()
         self._supervisor = None  # multi-worker serving (httpapi/workers.py)
@@ -137,7 +152,10 @@ class BanjaxApp:
 
         self._matcher = None
         self._matcher_generation = -1
-        self.tailer = LogTailer(config.server_log_file, self._consume_lines)
+        self.tailer = LogTailer(
+            config.server_log_file, self._consume_lines,
+            health=self.health.register("tailer", stale_after=60.0),
+        )
 
         self.kafka_reader: Optional[KafkaReader] = None
         self.kafka_writer: Optional[KafkaWriter] = None
@@ -150,6 +168,7 @@ class BanjaxApp:
             self.failed_challenge_states,
             matcher_getter=lambda: self._matcher,
             supervisor_getter=lambda: self._supervisor,
+            health=self.health,
         )
 
         gin_log_name = "gin.log" if config.standalone_testing else config.gin_log_file
@@ -194,17 +213,19 @@ class BanjaxApp:
             if self._matcher is not None:
                 self._matcher.close()
             self._matcher = build_matcher(
-                cfg, self.banner, self.static_lists, self.regex_states
+                cfg, self.banner, self.static_lists, self.regex_states,
+                health=self.health,
             )
             self._matcher_generation = self.config_holder.generation
         return cfg, self._matcher
 
-    def _consume_lines(self, lines) -> None:
+    def _consume_lines(self, lines):
         cfg, matcher = self._current_matcher()
         results = matcher.consume_lines(lines)
         if cfg.debug:
             for result in results:
                 log.debug("consumeLine: %s", result)
+        return results  # the tailer ignores this; fault tests assert on it
 
     def start_workers(self) -> None:
         """Launch tailer, Kafka, metrics, heartbeat (not the HTTP server)."""
@@ -215,13 +236,22 @@ class BanjaxApp:
             log.info("INIT: not running Kafka reader/writer due to disable_kafka")
         elif config.disable_kafka_writer:
             log.info("INIT: starting Kafka reader only due to disable_kafka_writer")
-            self.kafka_reader = KafkaReader(self.config_holder, self.dynamic_lists)
+            self.kafka_reader = KafkaReader(
+                self.config_holder, self.dynamic_lists,
+                health=self.health.register("kafka-reader"),
+            )
             self.kafka_reader.start()
         else:
             log.info("INIT: starting Kafka reader/writer")
-            self.kafka_reader = KafkaReader(self.config_holder, self.dynamic_lists)
+            self.kafka_reader = KafkaReader(
+                self.config_holder, self.dynamic_lists,
+                health=self.health.register("kafka-reader"),
+            )
             self.kafka_reader.start()
-            self.kafka_writer = KafkaWriter(self.config_holder)
+            self.kafka_writer = KafkaWriter(
+                self.config_holder,
+                health=self.health.register("kafka-writer"),
+            )
             self.kafka_writer.start()
 
         self.metrics.start()
@@ -246,6 +276,7 @@ class BanjaxApp:
             banner=self.banner,
             gin_log_file=self._gin_log_file,
             server_log_file=self._server_log_file,
+            health=self.health,
         )
 
     async def _serve(self, install_signal_handlers: bool) -> None:
@@ -256,7 +287,8 @@ class BanjaxApp:
 
             ctrl_dir = tempfile.mkdtemp(prefix="banjax-ctrl-")
             self._supervisor = PrimarySupervisor(
-                self, ctrl_dir, self._n_http_workers
+                self, ctrl_dir, self._n_http_workers,
+                health=self.health.register("worker-supervisor"),
             )
             self.dynamic_lists.set_broadcast(self._supervisor.control.broadcast)
             runner = await run_http_server(

@@ -190,6 +190,19 @@ class Config:
     # wire contract (cold routes proxied to the aiohttp app over a unix
     # socket). false restores the pure-aiohttp layout.
     http_fast_path: bool = True
+    # circuit breaker around the TPU matcher batch path (resilience/
+    # breaker.py): this many consecutive device failures (or latency-
+    # budget breaches) route batches to the CPU reference matcher until a
+    # half-open probe succeeds after breaker_recovery_seconds
+    breaker_failure_threshold: int = 3
+    breaker_recovery_seconds: float = 30.0
+    # per-batch latency budget for the matcher in milliseconds; a batch
+    # slower than this counts as a breaker failure. 0 disables the check.
+    matcher_latency_budget_ms: float = 0.0
+    # deterministic fault injection (resilience/failpoints.py): same spec
+    # syntax as the BANJAX_FAILPOINTS env var, e.g.
+    # "matcher.device=error:5;kafka.read=error". Empty = nothing armed.
+    failpoints: str = ""
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -224,6 +237,8 @@ _SCALAR_KEYS = {
     "matcher_mesh_devices": int, "matcher_mesh_rp": int,
     "matcher_native_parse": bool, "http_workers": int,
     "http_fast_path": bool,
+    "breaker_failure_threshold": int, "breaker_recovery_seconds": float,
+    "matcher_latency_budget_ms": float, "failpoints": str,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -316,6 +331,17 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
         raise ValueError(
             f"config key matcher_mesh_rp: {cfg.matcher_mesh_rp} does not "
             f"divide matcher_mesh_devices {cfg.matcher_mesh_devices}"
+        )
+    if cfg.breaker_failure_threshold < 1:
+        raise ValueError(
+            "config key breaker_failure_threshold: expected >= 1, got "
+            f"{cfg.breaker_failure_threshold}"
+        )
+    if cfg.breaker_recovery_seconds < 0 or cfg.matcher_latency_budget_ms < 0:
+        raise ValueError(
+            "config keys breaker_recovery_seconds/matcher_latency_budget_ms: "
+            f"expected non-negative, got {cfg.breaker_recovery_seconds}/"
+            f"{cfg.matcher_latency_budget_ms}"
         )
 
     return cfg

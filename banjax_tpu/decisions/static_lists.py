@@ -43,13 +43,15 @@ def _fast_parse_ip(ip_string: str) -> Optional[Tuple[int, int]]:
     whitespace and out-of-range octets all rejected the same way).  Scoped
     IPv6 ("%zone", which ipaddress accepts but inet_pton rejects) returns
     None so callers take the slow exact-semantics path."""
-    # OSError: not parseable; ValueError: embedded NUL / non-str input
+    # OSError: not parseable; ValueError: embedded NUL / non-str input.
+    # byteorder is explicit: it only defaults to 'big' on Python >= 3.11,
+    # and this parser must work on 3.10 too.
     try:
-        return 4, int.from_bytes(socket.inet_pton(socket.AF_INET, ip_string))
+        return 4, int.from_bytes(socket.inet_pton(socket.AF_INET, ip_string), "big")
     except (OSError, ValueError):
         pass
     try:
-        return 6, int.from_bytes(socket.inet_pton(socket.AF_INET6, ip_string))
+        return 6, int.from_bytes(socket.inet_pton(socket.AF_INET6, ip_string), "big")
     except (OSError, ValueError):
         return None
 

@@ -38,6 +38,7 @@ from banjax_tpu.crypto.challenge import (
     validate_password_cookie,
     validate_sha_inv_cookie,
 )
+from banjax_tpu.resilience import failpoints
 from banjax_tpu.crypto.integrity import (
     INTEGRITY_CHECK_COOKIE_NAME,
     IntegrityCheckPayloadWrapper,
@@ -568,6 +569,10 @@ def decision_for_nginx(
     state: ChainState, req: RequestInfo
 ) -> Tuple[Response, DecisionForNginxResult]:
     """Port of decisionForNginx2 (http_server.go:861-1136)."""
+    # fault-injection seam: an armed `decision_chain` failpoint raises here
+    # so tests/faults/ can prove the recovery middleware's fail-open
+    # contract (500 + X-Accel-Redirect: @fail_open) end to end
+    failpoints.check("decision_chain")
     config = state.config
     result = DecisionForNginxResult(
         client_ip=req.client_ip,

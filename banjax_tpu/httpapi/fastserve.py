@@ -56,6 +56,18 @@ def _reason(status: int) -> str:
     return _REASONS.get(status, "Unknown")
 
 
+def _clean_header(value) -> str:
+    """CR/LF-sanitize a header name or value (aiohttp rejects them; the
+    hand-rolled serializer must too).  The fail-open 500 path puts raw
+    exception text — which can embed client-controlled bytes — into
+    X-Banjax-Error, so unsanitized \\r\\n here is a response-splitting
+    vector."""
+    s = str(value)
+    if "\r" in s or "\n" in s:
+        s = s.replace("\r", " ").replace("\n", " ")
+    return s
+
+
 def serialize_response(resp: Response, keep_alive: bool,
                        head_only: bool = False) -> bytes:
     """Response dataclass → HTTP/1.1 bytes (matches what the aiohttp app
@@ -71,10 +83,13 @@ def serialize_response(resp: Response, keep_alive: bool,
         f"Content-Length: {len(body)}",
     ]
     for k, v in resp.headers.items():
-        lines.append(f"{k}: {v}")
+        lines.append(f"{_clean_header(k)}: {_clean_header(v)}")
     for c in resp.cookies:
         attrs = [f"{c.name}={go_query_escape(c.value)}"]
-        if c.max_age:
+        # `is not None`: Max-Age=0 (immediate expiry) must reach the wire —
+        # the aiohttp layout emits it, and a bare `if c.max_age:` turned it
+        # into a session cookie on this layout (ADVICE r5)
+        if c.max_age is not None:
             attrs.append(f"Max-Age={c.max_age}")
         if c.domain:
             attrs.append(f"Domain={c.domain}")
@@ -83,7 +98,7 @@ def serialize_response(resp: Response, keep_alive: bool,
             attrs.append("Secure")
         if c.http_only:
             attrs.append("HttpOnly")
-        lines.append("Set-Cookie: " + "; ".join(attrs))
+        lines.append("Set-Cookie: " + _clean_header("; ".join(attrs)))
     lines.append("Connection: keep-alive" if keep_alive else "Connection: close")
     head = ("\r\n".join(lines) + "\r\n\r\n").encode()
     return head if head_only else head + body
@@ -345,13 +360,18 @@ class FastPathServer:
 
     def is_hot(self, req: _ParsedRequest) -> bool:
         # exact route + method matching, mirroring the aiohttp router:
-        # /auth_request is ANY-method; /info and /favicon.ico are GET-only
-        # (other methods proxy upstream and get aiohttp's 405/404)
+        # /auth_request is ANY-method; /info, /healthz and /favicon.ico are
+        # GET-only (other methods proxy upstream and get aiohttp's 405/404)
         path = req.path
         if path == "/auth_request":
             return True
         if req.method != "GET":
             return False
+        if path == "/healthz" and self.deps.health is not None:
+            # served natively so health stays answerable even when the
+            # aiohttp upstream is the thing that is wedged; a worker
+            # (health is None there) proxies it to the primary instead
+            return True
         return path == "/info" or (self.standalone and path == "/favicon.ico")
 
     def handle_hot(self, proto: FastHttpProtocol, req: _ParsedRequest) -> None:
@@ -393,6 +413,13 @@ class FastPathServer:
             # aiohttp's json_response content type, charset included
             resp = Response(status=200, body=body,
                             content_type="application/json; charset=utf-8")
+        elif path == "/healthz":
+            snap = self.deps.health.snapshot()
+            resp = Response(
+                status=503 if snap["status"] == "failed" else 200,
+                body=json.dumps(snap).encode(),
+                content_type="application/json; charset=utf-8",
+            )
         elif path == "/favicon.ico":
             # the aiohttp route uses web.Response(text="") — charset added
             resp = Response(status=200, body=b"",

@@ -47,6 +47,7 @@ from banjax_tpu.httpapi.decision_chain import (
     Response,
     decision_for_nginx,
 )
+from banjax_tpu.httpapi.fastserve import _clean_header
 from banjax_tpu.utils import go_query_escape, go_query_unescape
 
 log = logging.getLogger(__name__)
@@ -66,6 +67,7 @@ class ServerDeps:
     banner: BannerInterface
     gin_log_file: Optional[TextIO] = None  # the JSON access log
     server_log_file: Optional[TextIO] = None  # standalone: fake nginx log
+    health: Optional[object] = None  # resilience.health.HealthRegistry
 
 
 _STANDALONE_KEY = "banjax_standalone_hdrs"
@@ -204,8 +206,12 @@ def build_app(deps: ServerDeps,
             tb = traceback.extract_tb(e.__traceback__)
             location = f"{tb[-1].filename}:{tb[-1].lineno}" if tb else "unknown"
             log.error("handler panic: %s (%s)", e, location)
+            # CR/LF-sanitized: exception text can embed client-controlled
+            # bytes, and an unsanitizable header value would make aiohttp
+            # raise INSIDE the crash handler — dropping the fail-open
+            # contract exactly when it matters
             headers = {
-                "X-Banjax-Error": f"{e} ({location})",
+                "X-Banjax-Error": _clean_header(f"{e} ({location})"),
                 "X-Accel-Redirect": "@fail_open",
             }
             return web.Response(status=500, headers=headers)
@@ -421,9 +427,22 @@ def build_app(deps: ServerDeps,
             }
         )
 
+    async def healthz(request: web.Request) -> web.Response:
+        # the component health aggregate (resilience/health.py): 200 while
+        # serving is possible (HEALTHY or DEGRADED — degraded modes still
+        # answer traffic), 503 only when a component has FAILED
+        if deps.health is None:
+            return web.json_response({"status": "unknown", "components": {}})
+        snap = deps.health.snapshot()
+        status = 503 if snap["status"] == "failed" else 200
+        return web.json_response(snap, status=status)
+
     app.router.add_route("*", "/auth_request", auth_request)
     app.router.add_get("/info", info)
     if worker_proxy_sock is None:
+        # /healthz is primary-owned (the registry lives there); workers
+        # reverse-proxy it like the other cold routes
+        app.router.add_get("/healthz", healthz)
         app.router.add_get("/decision_lists", decision_lists_route)
         app.router.add_get("/rate_limit_states", rate_limit_states_route)
         app.router.add_get("/is_banned", is_banned)

@@ -60,6 +60,7 @@ COLD_ROUTES = (
     "/ipset/list",
     "/banned",
     "/unban",
+    "/healthz",
 )
 
 
@@ -335,7 +336,8 @@ class PrimarySupervisor:
     RESPAWN_BACKOFF_S = (1.0, 2.0, 4.0, 8.0, 16.0)
     MONITOR_INTERVAL_S = 1.0
 
-    def __init__(self, app, ctrl_dir: str, n_workers: int) -> None:
+    def __init__(self, app, ctrl_dir: str, n_workers: int,
+                 health=None) -> None:
         self.ctrl_dir = ctrl_dir
         self.n_workers = n_workers
         self.control = ControlPlane(ctrl_dir, app)
@@ -345,6 +347,7 @@ class PrimarySupervisor:
         self._next_spawn_ok = [0.0] * n_workers
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+        self.health = health  # resilience.health.ComponentHealth
 
     def primary_http_sock(self) -> str:
         return os.path.join(self.ctrl_dir, PRIMARY_HTTP_SOCK)
@@ -388,8 +391,25 @@ class PrimarySupervisor:
         self._monitor.start()
         log.info("spawned %d http workers (ctrl %s)", self.n_workers, self.ctrl_dir)
 
+    def kill_worker(self, index: int, sig: int = 9) -> None:
+        """Fault-injection hook (tests/faults/): deliver `sig` (default
+        SIGKILL — the un-maskable OOM-kill shape) to one worker and let the
+        monitor heal it."""
+        proc = self._procs[index]
+        if proc.poll() is None:
+            os.kill(proc.pid, sig)
+
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.MONITOR_INTERVAL_S):
+            down = sum(1 for p in self._procs if p.poll() is not None)
+            if self.health is not None:
+                if down:
+                    self.health.degraded(
+                        f"{down}/{self.n_workers} http workers down "
+                        "(respawning)"
+                    )
+                else:
+                    self.health.ok()
             for i, proc in enumerate(self._procs):
                 try:
                     if proc.poll() is None:

@@ -38,10 +38,20 @@ from banjax_tpu.config.schema import Config
 from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
 from banjax_tpu.decisions.model import Decision
 from banjax_tpu.ingest.reports import get_message_queue
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.resilience.backoff import Backoff
+from banjax_tpu.resilience.health import ComponentHealth
 
 log = logging.getLogger(__name__)
 
-RECONNECT_SECONDS = 5  # kafka.go:169
+RECONNECT_SECONDS = 5  # kafka.go:169 — now the backoff CAP, not a fixed sleep
+
+
+def _reconnect_backoff() -> Backoff:
+    """Reconnects start fast (a transient blip recovers in ~½ s) and cap at
+    6x the reference's flat 5 s clock, with jitter so a fleet sharing a dead
+    broker doesn't stampede it in lockstep."""
+    return Backoff(base=0.5, cap=6 * RECONNECT_SECONDS, jitter=0.5)
 
 
 def get_dnet_partition(config: Config) -> int:
@@ -215,10 +225,14 @@ class KafkaReader:
         config_holder: ConfigHolder,
         decision_lists: DynamicDecisionLists,
         transport: Optional[KafkaTransport] = None,
+        backoff: Optional[Backoff] = None,
+        health: Optional[ComponentHealth] = None,
     ):
         self.config_holder = config_holder
         self.decision_lists = decision_lists
         self.transport = transport or default_transport()
+        self.backoff = backoff or _reconnect_backoff()
+        self.health = health
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -237,11 +251,17 @@ class KafkaReader:
             config = self.config_holder.get()
             partition = get_dnet_partition(config)
             try:
+                failpoints.check("kafka.read")
                 for raw in self.transport.read_messages(
                     config, config.kafka_command_topic, partition
                 ):
                     if self._stop.is_set():
                         return
+                    # a delivered message is the success signal: reset the
+                    # reconnect backoff and report healthy
+                    self.backoff.reset()
+                    if self.health is not None:
+                        self.health.ok()
                     try:
                         command = json.loads(raw)
                     except json.JSONDecodeError:
@@ -256,17 +276,28 @@ class KafkaReader:
                     handle_command(self.config_holder.get(), command, self.decision_lists)
             except Exception as e:  # noqa: BLE001 — any transport failure → reconnect
                 log.warning("KAFKA: reader failed: %s", e)
-            if self._stop.wait(RECONNECT_SECONDS):
+                if self.health is not None:
+                    self.health.degraded(f"reconnecting: {e}")
+            if self.backoff.wait(self._stop):
                 return
-            log.info("KAFKA: reconnecting kafka reader")
+            log.info("KAFKA: reconnecting kafka reader (attempt %d)",
+                     self.backoff.attempt)
 
 
 class KafkaWriter:
     """kafka.go:353-406 — drain the report queue into the report topic."""
 
-    def __init__(self, config_holder: ConfigHolder, transport: Optional[KafkaTransport] = None):
+    def __init__(
+        self,
+        config_holder: ConfigHolder,
+        transport: Optional[KafkaTransport] = None,
+        backoff: Optional[Backoff] = None,
+        health: Optional[ComponentHealth] = None,
+    ):
         self.config_holder = config_holder
         self.transport = transport or default_transport()
+        self.backoff = backoff or _reconnect_backoff()
+        self.health = health
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -282,16 +313,30 @@ class KafkaWriter:
 
     def _run(self) -> None:
         message_queue = get_message_queue()
+        # the dequeued-but-unsent report: held across a transport failure
+        # and retried first after reconnect, so a send crash never drops
+        # the in-flight message (the producer side is drop-don't-block;
+        # the drain side must not lose what it already accepted)
+        pending: Optional[bytes] = None
         while not self._stop.is_set():
             config = self.config_holder.get()
             try:
                 while not self._stop.is_set():
-                    try:
-                        msg = message_queue.get(timeout=0.2)
-                    except queue.Empty:
-                        continue
-                    self.transport.send(config, config.kafka_report_topic, msg)
+                    if pending is None:
+                        try:
+                            pending = message_queue.get(timeout=0.2)
+                        except queue.Empty:
+                            continue
+                    failpoints.check("kafka.send")
+                    self.transport.send(config, config.kafka_report_topic, pending)
+                    pending = None
+                    self.backoff.reset()
+                    if self.health is not None:
+                        self.health.ok()
             except Exception as e:  # noqa: BLE001 — any transport failure → reconnect
-                log.warning("KAFKA: writer failed: %s", e)
-            if self._stop.wait(RECONNECT_SECONDS):
+                log.warning("KAFKA: writer failed: %s%s", e,
+                            " (1 report held for retry)" if pending else "")
+                if self.health is not None:
+                    self.health.degraded(f"reconnecting: {e}")
+            if self.backoff.wait(self._stop):
                 return

@@ -8,6 +8,12 @@ snapshot (so rate-limit rules hot-reload without restarting the tailer).
 The reference uses inotify via hpcloud/tail; here a poll-based follower
 (50 ms idle sleep) keeps the dependency surface zero and handles truncation
 and rotation (size shrink or inode change → reopen from start).
+
+Resilience: the retry-until-exists loop uses capped jittered exponential
+backoff instead of the reference's flat 5 s clock, the `tailer.open`
+failpoint injects deterministic open failures for the fault suite, and a
+health component heartbeats every poll iteration so a wedged tailer
+surfaces on /healthz.
 """
 
 from __future__ import annotations
@@ -15,12 +21,15 @@ from __future__ import annotations
 import logging
 import os
 import threading
-import time
 from typing import Callable, List, Optional
+
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.resilience.backoff import Backoff
+from banjax_tpu.resilience.health import ComponentHealth
 
 log = logging.getLogger(__name__)
 
-RETRY_SECONDS = 5  # regex_rate_limiter.go:47
+RETRY_SECONDS = 5  # regex_rate_limiter.go:47 — now the backoff cap
 POLL_SECONDS = 0.05
 
 
@@ -34,9 +43,16 @@ class LogTailer:
     default), preserving the reference's per-line semantics.
     """
 
-    def __init__(self, path: str, on_lines: Callable[[List[str]], None]):
+    def __init__(self, path: str, on_lines: Callable[[List[str]], None],
+                 backoff: Optional[Backoff] = None,
+                 health: Optional[ComponentHealth] = None):
         self.path = path
         self.on_lines = on_lines
+        self.backoff = backoff or Backoff(base=0.25, cap=RETRY_SECONDS, jitter=0.5)
+        self.health = health
+        # set once the log file is open and being followed (readiness
+        # signal for tests and supervisors; re-set after each reopen)
+        self.opened = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -49,59 +65,74 @@ class LogTailer:
         if self._thread is not None:
             self._thread.join(timeout=2)
 
-    def _open_at_end(self):
+    def _open(self, at_end: bool):
+        failpoints.check("tailer.open")
         f = open(self.path, "r", encoding="utf-8", errors="replace")
-        f.seek(0, os.SEEK_END)
+        if at_end:
+            f.seek(0, os.SEEK_END)
         return f
 
     def _run(self) -> None:
         f = None
-        # retry-until-exists loop (regex_rate_limiter.go:30-51)
-        while not self._stop.is_set():
-            try:
-                f = self._open_at_end()
-                break
-            except OSError:
-                log.info("log tailer failed to start. waiting a bit and trying again.")
-                if self._stop.wait(RETRY_SECONDS):
-                    return
-
-        if f is None:
-            return
-        log.info("log tailer started on %s", self.path)
-
-        inode = os.fstat(f.fileno()).st_ino
+        at_end = True  # first open seeks to EOF; rotation reopens from 0
+        inode = 0
         buffer = ""
-        while not self._stop.is_set():
-            chunk = f.read()
-            if chunk:
-                buffer += chunk
-                # one split, not a split-per-line loop: the repeated
-                # "rest of buffer" copy is O(n^2) on a big burst, which is
-                # exactly when the tailer must keep up
-                parts = buffer.split("\n")
-                buffer = parts.pop()
-                batch: List[str] = [line for line in parts if line]
-                if batch:
+        try:
+            while not self._stop.is_set():
+                if f is None:
+                    # retry-until-open loop (regex_rate_limiter.go:30-51),
+                    # shared by first start AND a failed rotation reopen —
+                    # an open error can never strand the follow loop on a
+                    # closed file handle
                     try:
-                        self.on_lines(batch)
-                    except Exception:  # noqa: BLE001 — a bad batch must not kill the tailer
-                        log.exception("error consuming log line batch")
-                continue
+                        f = self._open(at_end=at_end)
+                        inode = os.fstat(f.fileno()).st_ino
+                        buffer = ""
+                        self.backoff.reset()
+                        self.opened.set()
+                        log.info("log tailer started on %s", self.path)
+                        if self.health is not None:
+                            self.health.ok()
+                    except OSError as e:
+                        log.info("log tailer failed to start. waiting a bit "
+                                 "and trying again.")
+                        if self.health is not None:
+                            self.health.degraded(f"waiting for {self.path}: {e}")
+                        if self.backoff.wait(self._stop):
+                            return
+                        continue
 
-            # idle: check rotation/truncation
-            try:
-                st = os.stat(self.path)
-                pos = f.tell()
-                if st.st_ino != inode or st.st_size < pos:
-                    log.info("log file rotated/truncated; reopening")
-                    f.close()
-                    f = open(self.path, "r", encoding="utf-8", errors="replace")
-                    inode = os.fstat(f.fileno()).st_ino
-                    buffer = ""
+                if self.health is not None:
+                    self.health.beat()
+                chunk = f.read()
+                if chunk:
+                    buffer += chunk
+                    # one split, not a split-per-line loop: the repeated
+                    # "rest of buffer" copy is O(n^2) on a big burst, which is
+                    # exactly when the tailer must keep up
+                    parts = buffer.split("\n")
+                    buffer = parts.pop()
+                    batch: List[str] = [line for line in parts if line]
+                    if batch:
+                        try:
+                            self.on_lines(batch)
+                        except Exception:  # noqa: BLE001 — a bad batch must not kill the tailer
+                            log.exception("error consuming log line batch")
                     continue
-            except OSError:
-                pass
-            self._stop.wait(POLL_SECONDS)
 
-        f.close()
+                # idle: check rotation/truncation
+                try:
+                    st = os.stat(self.path)
+                    pos = f.tell()
+                    if st.st_ino != inode or st.st_size < pos:
+                        log.info("log file rotated/truncated; reopening")
+                        f.close()
+                        f = None
+                        at_end = False
+                        continue
+                except OSError:
+                    pass
+                self._stop.wait(POLL_SECONDS)
+        finally:
+            if f is not None:
+                f.close()

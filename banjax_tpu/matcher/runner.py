@@ -49,6 +49,9 @@ from banjax_tpu.matcher.workset import (
     unique_spans,
 )
 from banjax_tpu.matcher.rulec import compile_rules
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.resilience.breaker import CLOSED, CircuitBreaker
+from banjax_tpu.resilience.health import HealthRegistry, HealthStatus
 
 log = logging.getLogger(__name__)
 
@@ -63,11 +66,30 @@ class TpuMatcher(Matcher):
         decision_lists: StaticDecisionLists,
         rate_limit_states: RegexRateLimitStates,
         n_shards: int = 1,
+        health: Optional[HealthRegistry] = None,
     ):
         self.config = config
         self.banner = banner
         self.decision_lists = decision_lists
         self.rate_limit_states = rate_limit_states
+
+        # circuit breaker around the device batch path: consecutive device
+        # failures (or latency-budget breaches) trip it OPEN and every
+        # batch routes to the CPU reference matcher until a half-open
+        # probe succeeds — a wedged TPU degrades throughput, never drops
+        # log lines (resilience/breaker.py)
+        self.breaker = CircuitBreaker(
+            failure_threshold=getattr(config, "breaker_failure_threshold", 3),
+            recovery_seconds=getattr(config, "breaker_recovery_seconds", 30.0),
+            name="matcher-device",
+        )
+        self._latency_budget_s = (
+            getattr(config, "matcher_latency_budget_ms", 0.0) or 0.0
+        ) / 1e3
+        self.fallback_batches = 0  # batches served by the CPU fallback
+        self._cpu_fallback = None
+        self._health_registry = health
+        self._health = health.register("matcher") if health is not None else None
 
         # Rule table: per-site rules first, then global — rule id i here is
         # column i of the device match bitmap, end to end.
@@ -228,11 +250,16 @@ class TpuMatcher(Matcher):
 
             # block granularity only matters for the compiled kernel; the
             # XLA/interpret bodies shouldn't pad every batch to dp*128 rows
+            mesh_health = (
+                self._health_registry.register("matcher-mesh")
+                if self._health_registry is not None else None
+            )
+
             def _mk(backend):
                 return ShardedMatchBackend(
                     self.compiled, self._mesh, self._max_len, backend=backend,
                     block_b=128 if backend == "pallas" else 8,
-                    plan=mesh_plan,
+                    plan=mesh_plan, health=mesh_health,
                 )
 
             try:
@@ -335,11 +362,72 @@ class TpuMatcher(Matcher):
     def consume_lines(
         self, lines: Sequence[str], now_unix: Optional[float] = None
     ) -> List[ConsumeLineResult]:
+        """Breaker-guarded batch entry point.
+
+        OPEN → the batch goes straight to the CPU reference matcher (the
+        correctness oracle: byte-identical Decision stream, host-only).
+        CLOSED/HALF_OPEN → the device path runs; a device exception or a
+        latency-budget breach records a failure, and an excepting batch is
+        re-run on the CPU fallback so its lines are never dropped.  Device
+        dispatch happens before any Banner side effect fires, so the
+        failure-then-fallback rerun cannot double-apply effects.
+        """
         t0 = time.perf_counter()
         try:
-            return self._consume_lines_inner(lines, now_unix)
+            if not self.breaker.allow():
+                return self._fallback_consume(lines, now_unix)
+            try:
+                results = self._consume_lines_inner(lines, now_unix)
+            except Exception:  # noqa: BLE001 — device failure → breaker + fallback
+                log.exception(
+                    "device matcher batch failed; re-running batch on the "
+                    "CPU reference matcher"
+                )
+                self.breaker.record_failure()
+                return self._fallback_consume(lines, now_unix)
+            if (
+                self._latency_budget_s
+                and time.perf_counter() - t0 > self._latency_budget_s
+            ):
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+            self._note_health()
+            return results
         finally:
             self.stats.record_batch(len(lines), time.perf_counter() - t0)
+
+    def _fallback_matcher(self):
+        if self._cpu_fallback is None:
+            from banjax_tpu.matcher.cpu_ref import CpuMatcher
+
+            self._cpu_fallback = CpuMatcher(
+                self.config, self.banner, self.decision_lists,
+                self.rate_limit_states,
+            )
+        return self._cpu_fallback
+
+    def _fallback_consume(self, lines, now_unix) -> List[ConsumeLineResult]:
+        """CPU-reference degraded mode.  Note: with device windows enabled
+        the fallback counts in the host RegexRateLimitStates, so window
+        state diverges from the on-device counters for the duration of the
+        outage — under-counting briefly, exactly like the reference
+        restarting."""
+        self.fallback_batches += 1
+        self._note_health()
+        return self._fallback_matcher().consume_lines(list(lines), now_unix)
+
+    def _note_health(self) -> None:
+        if self._health is None:
+            return
+        state = self.breaker.state
+        if state == CLOSED:
+            self._health.ok()
+        else:
+            self._health.set_status(
+                HealthStatus.DEGRADED,
+                f"breaker {state}; batches on CPU reference matcher",
+            )
 
     def _consume_lines_inner(
         self, lines: Sequence[str], now_unix: Optional[float] = None
@@ -658,6 +746,7 @@ class TpuMatcher(Matcher):
         even across overflow fallbacks (an overflowing chunk drains all
         earlier chunks first, then replays classically before any later
         apply dispatches)."""
+        failpoints.check("matcher.device")
         from banjax_tpu.matcher.fused_windows import PipelineOverflow
 
         chunks = [
@@ -948,6 +1037,7 @@ class TpuMatcher(Matcher):
         consumes it directly — its plan is built against THIS matcher's
         byte classes (build_plan byte_classes=...), so the one encode
         feeds stage 1, stage 2, and the single-stage fallback."""
+        failpoints.check("matcher.device")
         n = len(work)
         rests = (
             None if pre_encoded is not None

@@ -109,6 +109,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.fc_snapshot.argtypes = [
             vp, ctypes.c_char_p, i32p, i32p, i64p, ctypes.c_int64,
         ]
+        lib.fc_set_steal_ns.restype = None
+        lib.fc_set_steal_ns.argtypes = [ctypes.c_int64]
+        lib.fc_test_lock_slot.restype = None
+        lib.fc_test_lock_slot.argtypes = [vp, ctypes.c_int64, ctypes.c_int32]
+        lib.fc_test_slot_owner.restype = ctypes.c_int32
+        lib.fc_test_slot_owner.argtypes = [vp, ctypes.c_int64]
         _LIB = lib
         return _LIB
 
@@ -235,6 +241,20 @@ class ShmFailedChallengeStates:
             f"{ip},: interval_start: {start}, num hits: {hits}\n"
             for ip, hits, start in self._entries()
         )
+
+    # --- fault-test hooks (tests/faults/test_shm_lock_steal.py) ---
+
+    def set_steal_ns(self, ns: int) -> None:
+        """Lower the lock-steal bound (process-wide, test-only)."""
+        self._lib.fc_set_steal_ns(ns)
+
+    def _test_lock_slot(self, idx: int, tag: int) -> None:
+        """Plant a raw owner tag on slot idx, simulating a holder that
+        died (dead pid tag) or wedged (live pid tag) mid-critical-section."""
+        self._lib.fc_test_lock_slot(self._base(), idx, tag)
+
+    def _test_slot_owner(self, idx: int) -> int:
+        return int(self._lib.fc_test_slot_owner(self._base(), idx))
 
     def close(self) -> None:
         self._base_ptr = None

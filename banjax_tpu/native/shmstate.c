@@ -19,12 +19,27 @@
  * Concurrency: one per-slot spinlock (acquire/release atomics); at most
  * one lock is ever held at a time.  Critical sections are a handful of
  * loads/stores.
+ *
+ * The lock word stores the OWNER'S PID (0 = free), not a plain flag, so
+ * a worker SIGKILLed mid-critical-section (OOM-kill, supervisor
+ * escalation) cannot wedge every survivor whose probe chain crosses the
+ * slot: a waiter that observes a dead owner (kill(pid, 0) == ESRCH)
+ * steals the lock immediately, and any owner — dead or merely wedged —
+ * is stolen from after a bounded wall-clock spin (default 50 ms; the
+ * critical sections are a few ns, so a live owner held that long is
+ * itself a failure).  Unlock is a CAS from our own pid so a robbed
+ * owner's late unlock cannot release the thief's lock.  The worst case
+ * of a false steal is one corrupted rate-limit slot, never a hang.
  */
 
+#include <errno.h>
+#include <signal.h>
 #include <stdint.h>
 #include <string.h>
+#include <time.h>
+#include <unistd.h>
 
-#define FC_MAGIC 0x626a7868736d3031LL /* "bjxhsm01" */
+#define FC_MAGIC 0x626a7868736d3032LL /* "bjxhsm02" — owner-pid lock words */
 #define FC_MAX_PROBE 64
 #define FC_KEY_MAX 104
 
@@ -51,14 +66,70 @@ typedef struct {
     char key[FC_KEY_MAX];
 } fc_slot; /* 128 bytes */
 
-static inline void fc_lock(fc_slot *s) {
-    while (__atomic_exchange_n(&s->lock, 1, __ATOMIC_ACQUIRE)) {
-        /* spin; critical sections are a few ns */
+static int64_t fc_steal_after_ns = 50 * 1000 * 1000; /* 50 ms default */
+
+/* test hook: lower the steal bound so the live-owner-steal path is
+ * provable without a 50 ms wait per case */
+void fc_set_steal_ns(int64_t ns) { fc_steal_after_ns = ns; }
+
+static inline int32_t fc_self_tag(void) {
+    /* benign race: every thread of a process writes the same value */
+    static int32_t tag;
+    if (tag == 0) {
+        tag = (int32_t)getpid();
+        if (tag == 0)
+            tag = 1;
+    }
+    return tag;
+}
+
+static inline int64_t fc_mono_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+static void fc_lock(fc_slot *s) {
+    int32_t tag = fc_self_tag();
+    int32_t expected = 0;
+    if (__atomic_compare_exchange_n(&s->lock, &expected, tag, 0,
+                                    __ATOMIC_ACQUIRE, __ATOMIC_RELAXED))
+        return; /* uncontended fast path */
+    int64_t t0 = 0;
+    int32_t spins = 0;
+    for (;;) {
+        int32_t owner = __atomic_load_n(&s->lock, __ATOMIC_RELAXED);
+        if (owner == 0) {
+            expected = 0;
+            if (__atomic_compare_exchange_n(&s->lock, &expected, tag, 0,
+                                            __ATOMIC_ACQUIRE,
+                                            __ATOMIC_RELAXED))
+                return;
+            continue;
+        }
+        if (++spins >= 1024) { /* syscalls only every ~1k spins */
+            spins = 0;
+            int64_t now = fc_mono_ns();
+            if (t0 == 0)
+                t0 = now;
+            int dead = (owner != tag && kill((pid_t)owner, 0) != 0 &&
+                        errno == ESRCH);
+            if (dead || now - t0 > fc_steal_after_ns) {
+                if (__atomic_compare_exchange_n(&s->lock, &owner, tag, 0,
+                                                __ATOMIC_ACQUIRE,
+                                                __ATOMIC_RELAXED))
+                    return; /* stolen from a dead/wedged owner */
+            }
+        }
     }
 }
 
 static inline void fc_unlock(fc_slot *s) {
-    __atomic_store_n(&s->lock, 0, __ATOMIC_RELEASE);
+    /* release only if still ours: if the lock was stolen (we were the
+     * presumed-dead owner), storing 0 here would unlock the thief */
+    int32_t tag = fc_self_tag();
+    __atomic_compare_exchange_n(&s->lock, &tag, 0, 0, __ATOMIC_RELEASE,
+                                __ATOMIC_RELAXED);
 }
 
 static inline uint64_t fc_hash(const char *key, int32_t len) {
@@ -217,4 +288,14 @@ int64_t fc_snapshot(void *base, char *keys_blob, int32_t *key_lens,
         fc_unlock(s);
     }
     return n;
+}
+
+/* test hooks: plant/read a raw owner tag so the fault suite can simulate
+ * a worker killed while holding a slot lock */
+void fc_test_lock_slot(void *base, int64_t idx, int32_t tag) {
+    __atomic_store_n(&fc_slots(base)[idx].lock, tag, __ATOMIC_RELEASE);
+}
+
+int32_t fc_test_slot_owner(void *base, int64_t idx) {
+    return __atomic_load_n(&fc_slots(base)[idx].lock, __ATOMIC_ACQUIRE);
 }

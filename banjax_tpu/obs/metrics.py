@@ -34,6 +34,7 @@ def write_metrics_line(
     failed_challenge_states: FailedChallengeRateLimitStates,
     matcher=None,
     supervisor=None,
+    health=None,
 ) -> None:
     challenges, blocks = dynamic_lists.metrics()
     line = {
@@ -57,6 +58,14 @@ def write_metrics_line(
         line["HttpFcDropped"] = getattr(
             failed_challenge_states, "dropped", 0
         )
+    if health is not None:
+        # component health (resilience/health.py): the /healthz aggregate,
+        # flattened onto the line so degraded modes are greppable in the
+        # same metrics stream operators already tail
+        snap = health.snapshot()
+        line["HealthStatus"] = snap["status"]
+        for name, comp in sorted(snap["components"].items()):
+            line[f"Health_{name}"] = comp["status"]
     out.write(json.dumps(line) + "\n")
     out.flush()
 
@@ -71,6 +80,7 @@ class MetricsReporter:
         interval_seconds: float = REPORT_INTERVAL_SECONDS,
         matcher_getter: Optional[Callable[[], object]] = None,
         supervisor_getter: Optional[Callable[[], object]] = None,
+        health=None,
     ):
         self.log_path = log_path
         self.dynamic_lists = dynamic_lists
@@ -80,6 +90,7 @@ class MetricsReporter:
         # a getter, not the matcher itself: SIGHUP reload swaps the matcher
         self.matcher_getter = matcher_getter
         self.supervisor_getter = supervisor_getter
+        self.health = health
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -102,4 +113,5 @@ class MetricsReporter:
                 write_metrics_line(
                     out, self.dynamic_lists, self.regex_states,
                     self.failed_challenge_states, matcher, supervisor,
+                    self.health,
                 )

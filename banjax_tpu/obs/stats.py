@@ -87,4 +87,15 @@ class MatcherStats:
             if fw is not None:
                 out["PipelineFusedBatches"] = fw.fused_batches
                 out["PipelineFallbackBatches"] = fw.fallback_batches
+            # circuit breaker (resilience/breaker.py): the one place all
+            # the ad-hoc fallback counters roll up for operators —
+            # nonzero MatcherCpuFallbackBatches = batches served in
+            # degraded (CPU reference) mode
+            br = getattr(matcher, "breaker", None)
+            if br is not None:
+                out["MatcherBreakerState"] = br.state
+                out["MatcherBreakerTrips"] = br.trip_count
+                out["MatcherCpuFallbackBatches"] = getattr(
+                    matcher, "fallback_batches", 0
+                )
         return out

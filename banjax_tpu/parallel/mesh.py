@@ -32,9 +32,20 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
-    from jax import shard_map  # jax >= 0.8
+    from jax import shard_map as _shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover — older jax
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """Version shim: the replication-check kwarg was renamed check_rep →
+    check_vma across jax releases; accept either installed spelling."""
+    try:
+        return _shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_vma=check_vma)
+    except TypeError:
+        return _shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_rep=check_vma)
 
 from banjax_tpu.matcher import nfa_jax
 from banjax_tpu.matcher.kernels import nfa_match as pallas_nfa
@@ -430,7 +441,9 @@ class ShardedMatchBackend:
         block_b: int = 128,
         plan=None,                 # prefilter.PrefilterPlan (stage2 rp-packed)
         cand_frac: float = 0.125,
+        health=None,               # resilience.health.ComponentHealth
     ):
+        self.health = health
         self.mesh = mesh
         self.dp = mesh.shape["dp"]
         self.rp = mesh.shape["rp"]
@@ -550,11 +563,15 @@ class ShardedMatchBackend:
                 bits_d, n_cand = fn(
                     *params, jnp.asarray(cls_t), jnp.asarray(lens_dev)
                 )
+            if self.health is not None:
+                self.health.beat()
             if int(np.asarray(n_cand).max()) <= K:
                 # np.array (not asarray): the jax buffer is read-only and
                 # the always-rule flags write into it below
                 out = np.array(bits_d)
                 self.fused_batches += 1
+                if self.health is not None:
+                    self.health.ok()
                 # always-rule static flags (host-applied, like the
                 # single-device collect())
                 plan = self.plan
@@ -568,6 +585,13 @@ class ShardedMatchBackend:
                         out[np.ix_(empty_rows, plan.a_idx[ae])] = 1
             else:
                 self.fallback_batches += 1
+                if self.health is not None:
+                    # correctness-preserving but slower: the single-stage
+                    # sharded NFA reruns the whole batch
+                    self.health.degraded(
+                        f"fused prefilter overflow x{self.fallback_batches}; "
+                        "single-stage rerun"
+                    )
         if out is None:
             fn = self._fn(Bp, L_p)
             if self.backend == "xla":

@@ -1,0 +1,35 @@
+"""Failure-handling layer: health registry, backoff, circuit breaker,
+deterministic fault injection.
+
+The reference is one Go process whose goroutines are restarted by a
+supervisor; wedged components surface as crashed goroutines.  This port
+runs long-lived Python threads and device dispatches instead, so failure
+handling is explicit:
+
+  * `health`     — every long-lived loop registers a component and
+                   heartbeats it; /healthz and the 29 s metrics line
+                   surface the aggregate;
+  * `backoff`    — capped exponential backoff with jitter for every
+                   reconnect loop (replaces the fixed 5 s sleeps);
+  * `breaker`    — a circuit breaker around the TPU matcher batch path
+                   (device failures route batches to the CPU reference
+                   matcher until a half-open probe succeeds);
+  * `failpoints` — named, deterministic fault injection (no-op unless
+                   armed via config/env), exercised by tests/faults/.
+"""
+
+from banjax_tpu.resilience.backoff import Backoff
+from banjax_tpu.resilience.breaker import CircuitBreaker
+from banjax_tpu.resilience.health import (
+    ComponentHealth,
+    HealthRegistry,
+    HealthStatus,
+)
+
+__all__ = [
+    "Backoff",
+    "CircuitBreaker",
+    "ComponentHealth",
+    "HealthRegistry",
+    "HealthStatus",
+]

@@ -1,0 +1,96 @@
+"""Circuit breaker for the TPU matcher batch path.
+
+States: CLOSED (device path runs), OPEN (every batch routes straight to
+the CPU reference matcher), HALF_OPEN (one probe batch is allowed through
+the device path; success closes the breaker, failure re-opens it).
+
+Trips after `failure_threshold` consecutive failures — a device dispatch
+raising, or a batch breaching the latency budget — so a wedged TPU
+degrades throughput instead of dropping log lines.  The clock is
+injectable for deterministic recovery tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class CircuitBreaker:
+    """Thread-safe; `allow()` + `record_success()`/`record_failure()`
+    bracket each protected call."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        recovery_seconds: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        name: str = "",
+        on_trip: Optional[Callable[[str], None]] = None,
+    ):
+        if failure_threshold < 1:
+            raise ValueError(f"failure_threshold must be >= 1, got {failure_threshold}")
+        self.failure_threshold = failure_threshold
+        self.recovery_seconds = recovery_seconds
+        self.name = name
+        self._clock = clock
+        self._on_trip = on_trip
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self.trip_count = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """True: caller may take the protected (device) path. False:
+        caller must use the fallback."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self.recovery_seconds:
+                    self._state = HALF_OPEN
+                    self._probe_in_flight = True
+                    return True
+                return False
+            # HALF_OPEN: exactly one probe at a time
+            if not self._probe_in_flight:
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_in_flight = False
+            self._state = CLOSED
+
+    def record_failure(self) -> None:
+        tripped = False
+        with self._lock:
+            self._probe_in_flight = False
+            if self._state == HALF_OPEN:
+                self._state = OPEN
+                self._opened_at = self._clock()
+                self.trip_count += 1
+                tripped = True
+            else:
+                self._failures += 1
+                if self._state == CLOSED and self._failures >= self.failure_threshold:
+                    self._state = OPEN
+                    self._opened_at = self._clock()
+                    self.trip_count += 1
+                    tripped = True
+        if tripped and self._on_trip is not None:
+            self._on_trip(self.name)

@@ -1,0 +1,142 @@
+"""Named failpoints: deterministic fault injection.
+
+Instrumented sites call `check("site.name")`; when the failpoint is
+disarmed (production) that is one module-flag test — effectively free on
+the hot path.  Armed failpoints raise `FaultInjected` (an OSError
+subclass, so sites that tolerate I/O errors — the tailer's retry loop,
+the kafka reconnect loop — treat an injected fault exactly like a real
+one) a bounded or unbounded number of times.
+
+Arming:
+  * programmatic (tests):  failpoints.arm("matcher.device", count=3)
+  * env / config:          BANJAX_FAILPOINTS="matcher.device=error:3;kafka.read=error"
+    (the config key `failpoints` uses the same spec syntax)
+
+Instrumented sites in this tree:
+  kafka.read       — KafkaReader, before the transport read loop
+  kafka.send       — KafkaWriter, before each transport send
+  tailer.open      — LogTailer, every file open (start and rotation)
+  matcher.device   — TpuMatcher, every device dispatch boundary
+  decision_chain   — decision_for_nginx entry (fail-open path)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+class FaultInjected(OSError):
+    """Raised by an armed failpoint (OSError: see module docstring)."""
+
+
+class _Failpoint:
+    __slots__ = ("name", "mode", "remaining", "message", "fired", "delay_s")
+
+    def __init__(self, name: str, mode: str = "error",
+                 count: Optional[int] = None, message: str = "",
+                 delay_s: float = 0.0):
+        self.name = name
+        self.mode = mode          # "error" | "sleep"
+        self.remaining = count    # None = unlimited
+        self.message = message or f"failpoint {name} armed"
+        self.delay_s = delay_s
+        self.fired = 0
+
+
+_lock = threading.Lock()
+_active: Dict[str, _Failpoint] = {}
+_armed = False  # the fast gate read without the lock
+
+
+def check(name: str) -> None:
+    """The instrumented-site call: no-op unless `name` is armed."""
+    if not _armed:
+        return
+    with _lock:
+        fp = _active.get(name)
+        if fp is None:
+            return
+        if fp.remaining is not None:
+            if fp.remaining <= 0:
+                return
+            fp.remaining -= 1
+        fp.fired += 1
+        mode, message, delay = fp.mode, fp.message, fp.delay_s
+    if mode == "sleep":
+        time.sleep(delay)
+        return
+    raise FaultInjected(message)
+
+
+def arm(name: str, mode: str = "error", count: Optional[int] = None,
+        message: str = "", delay_s: float = 0.0) -> None:
+    global _armed
+    with _lock:
+        _active[name] = _Failpoint(name, mode, count, message, delay_s)
+        _armed = True
+    log.warning("FAILPOINT armed: %s mode=%s count=%s", name, mode, count)
+
+
+def disarm(name: Optional[str] = None) -> None:
+    """Disarm one failpoint, or all of them (name=None)."""
+    global _armed
+    with _lock:
+        if name is None:
+            _active.clear()
+        else:
+            _active.pop(name, None)
+        _armed = bool(_active)
+
+
+def fired_count(name: str) -> int:
+    with _lock:
+        fp = _active.get(name)
+        return fp.fired if fp is not None else 0
+
+
+def is_armed(name: str) -> bool:
+    with _lock:
+        fp = _active.get(name)
+        return fp is not None and (fp.remaining is None or fp.remaining > 0)
+
+
+def arm_from_spec(spec: str) -> None:
+    """Parse "name=mode[:count][;name2=..]" (the BANJAX_FAILPOINTS / config
+    syntax).  A bare "name" arms an unlimited error failpoint.  Bad entries
+    are logged and skipped — a typo in a fault spec must not stop a
+    production start."""
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, rest = entry.partition("=")
+        name = name.strip()
+        mode, count = "error", None
+        if rest:
+            mode, _, count_s = rest.partition(":")
+            mode = mode.strip() or "error"
+            if count_s:
+                try:
+                    count = int(count_s)
+                except ValueError:
+                    log.warning("FAILPOINT: bad count in spec entry %r", entry)
+                    continue
+        if mode not in ("error", "sleep"):
+            log.warning("FAILPOINT: unknown mode in spec entry %r", entry)
+            continue
+        arm(name, mode=mode, count=count)
+
+
+def _load_env() -> None:
+    spec = os.environ.get("BANJAX_FAILPOINTS", "")
+    if spec:
+        arm_from_spec(spec)
+
+
+_load_env()

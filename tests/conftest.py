@@ -27,11 +27,86 @@ jax.config.update("jax_platforms", "cpu")
 
 
 import shutil  # noqa: E402
+import threading  # noqa: E402
+import time  # noqa: E402
 from pathlib import Path  # noqa: E402
 
 import pytest  # noqa: E402
 
 _FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')"
+    )
+    config.addinivalue_line(
+        "markers", "allow_leaks: opt out of the leaked thread/process guard"
+    )
+
+
+def _live_child_pids():
+    """PIDs of live (non-zombie) direct children, excluding the
+    multiprocessing resource tracker (session-lived by design)."""
+    if not os.path.isdir("/proc"):
+        return set()
+    me = os.getpid()
+    out = set()
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat", "rb") as f:
+                stat = f.read().decode("latin-1")
+            # fields after the parenthesized comm: state is 1st, ppid 2nd
+            rest = stat.rsplit(")", 1)[1].split()
+            state, ppid = rest[0], int(rest[1])
+            if ppid != me or state == "Z":
+                continue
+            with open(f"/proc/{entry}/cmdline", "rb") as f:
+                cmdline = f.read()
+            if b"resource_tracker" in cmdline:
+                continue
+            out.add(int(entry))
+        except (OSError, IndexError, ValueError):
+            continue  # raced a process exit
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _leak_guard(request):
+    """Fail any test that leaves a non-daemon thread or a live child
+    process behind — a leaked worker keeps ports/shm segments alive and
+    poisons every later test in the session.  Teardown of the test's own
+    fixtures (e.g. app_factory stopping the app) runs BEFORE this check.
+    Mark a test `@pytest.mark.allow_leaks` to opt out."""
+    if request.node.get_closest_marker("allow_leaks"):
+        yield
+        return
+    threads_before = set(threading.enumerate())
+    children_before = _live_child_pids()
+    yield
+
+    def leaked():
+        lt = [
+            t for t in threading.enumerate()
+            if t not in threads_before and t.is_alive() and not t.daemon
+        ]
+        lc = _live_child_pids() - children_before
+        return lt, lc
+
+    # grace window: joins/waitpids triggered by fixture teardown may still
+    # be settling when we first look
+    deadline = time.monotonic() + 3.0
+    lt, lc = leaked()
+    while (lt or lc) and time.monotonic() < deadline:
+        time.sleep(0.05)
+        lt, lc = leaked()
+    if lt or lc:
+        pytest.fail(
+            f"test leaked non-daemon threads {[t.name for t in lt]} "
+            f"and/or live child processes {sorted(lc)}"
+        )
 
 
 @pytest.fixture()

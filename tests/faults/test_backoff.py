@@ -1,0 +1,58 @@
+"""Backoff unit behavior: exponential growth, cap, jitter bounds, reset."""
+
+import random
+import threading
+
+import pytest
+
+from banjax_tpu.resilience.backoff import Backoff
+
+
+class _ZeroRng(random.Random):
+    """random() == 0.0 → jitter factor 1.0 (the deterministic upper edge)."""
+
+    def random(self):
+        return 0.0
+
+
+def test_exponential_growth_and_cap():
+    b = Backoff(base=1.0, cap=8.0, factor=2.0, jitter=0.5, rng=_ZeroRng())
+    assert [b.next_delay() for _ in range(6)] == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+
+def test_reset_returns_to_base():
+    b = Backoff(base=1.0, cap=30.0, factor=2.0, jitter=0.5, rng=_ZeroRng())
+    b.next_delay()
+    b.next_delay()
+    b.reset()
+    assert b.next_delay() == 1.0
+
+
+def test_jitter_stays_in_band():
+    b = Backoff(base=2.0, cap=2.0, factor=2.0, jitter=0.5,
+                rng=random.Random(42))
+    for _ in range(200):
+        d = b.next_delay()
+        # jitter factor uniform in [1 - jitter, 1]
+        assert 1.0 <= d <= 2.0
+
+
+def test_injected_sleep_receives_delays_and_stop_flag():
+    seen = []
+    b = Backoff(base=1.0, cap=4.0, jitter=0.0,
+                sleep=lambda d: (seen.append(d), False)[1])
+    stop = threading.Event()
+    assert b.wait(stop) is False
+    assert b.wait(stop) is False
+    assert seen == [1.0, 2.0]
+
+
+def test_bad_parameters_rejected():
+    for kwargs in (
+        dict(base=0),
+        dict(base=2.0, cap=1.0),
+        dict(factor=0.5),
+        dict(jitter=1.0),
+    ):
+        with pytest.raises(ValueError):
+            Backoff(**kwargs)

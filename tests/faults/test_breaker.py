@@ -1,0 +1,73 @@
+"""Circuit breaker state machine: trip, open rejection, half-open probe,
+recovery, re-trip — all on an injected clock."""
+
+import pytest
+
+from banjax_tpu.resilience.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make(threshold=3, recovery=30.0):
+    clk = Clock()
+    return CircuitBreaker(failure_threshold=threshold,
+                          recovery_seconds=recovery, clock=clk), clk
+
+
+def test_trips_after_consecutive_failures_only():
+    br, _ = make(threshold=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()  # success resets the consecutive count
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CLOSED
+    br.record_failure()
+    assert br.state == OPEN
+    assert br.trip_count == 1
+
+
+def test_open_rejects_until_recovery_then_half_open_single_probe():
+    br, clk = make(threshold=1, recovery=10.0)
+    br.record_failure()
+    assert br.state == OPEN
+    assert not br.allow()
+    clk.t = 9.9
+    assert not br.allow()
+    clk.t = 10.0
+    assert br.allow()  # the half-open probe
+    assert br.state == HALF_OPEN
+    assert not br.allow()  # only ONE probe at a time
+    br.record_success()
+    assert br.state == CLOSED
+    assert br.allow()
+
+
+def test_half_open_failure_reopens_with_fresh_recovery_window():
+    br, clk = make(threshold=1, recovery=10.0)
+    br.record_failure()
+    clk.t = 10.0
+    assert br.allow()
+    br.record_failure()  # probe failed
+    assert br.state == OPEN
+    assert br.trip_count == 2
+    clk.t = 19.9  # recovery restarts from the re-trip
+    assert not br.allow()
+    clk.t = 20.0
+    assert br.allow()
+
+
+def test_on_trip_callback_and_validation():
+    trips = []
+    br = CircuitBreaker(failure_threshold=1, recovery_seconds=1.0,
+                        name="x", on_trip=trips.append)
+    br.record_failure()
+    assert trips == ["x"]
+    with pytest.raises(ValueError):
+        CircuitBreaker(failure_threshold=0)

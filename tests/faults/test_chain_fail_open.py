@@ -1,0 +1,56 @@
+"""Decision-chain fail-open, end to end on both HTTP layouts: a crash in
+decision_for_nginx (injected via the decision_chain failpoint) must produce
+the reference's recovery contract — 500 + X-Accel-Redirect: @fail_open +
+X-Banjax-Error — and the exception text must be CR/LF-sanitized so it
+cannot split the response (ADVICE r5)."""
+
+from pathlib import Path
+
+import pytest
+import requests
+
+from banjax_tpu.resilience import failpoints
+
+BASE = "http://localhost:8081"
+_FIXTURES = Path(__file__).resolve().parent.parent / "fixtures"
+
+INJECTED = "boom\r\nX-Injected: owned\r\n\r\nHTTP/1.1 200 OK"
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+
+
+@pytest.mark.parametrize("fast_path", [True, False], ids=["fastserve", "aiohttp"])
+def test_fail_open_with_hostile_exception_text(app_factory, tmp_path, fast_path):
+    custom = tmp_path / "banjax-config-failopen.yaml"
+    custom.write_text(
+        (_FIXTURES / "banjax-config-test.yaml").read_text()
+        + f"\nhttp_fast_path: {str(fast_path).lower()}\ndisable_kafka: true\n"
+    )
+    app_factory(str(custom))
+
+    failpoints.arm("decision_chain", message=INJECTED)
+    r = requests.get(
+        f"{BASE}/auth_request", params={"path": "/x"},
+        headers={"X-Client-IP": "3.3.3.3"}, timeout=5,
+    )
+    # the fail-open contract (http_server.go:110-135)
+    assert r.status_code == 500
+    assert r.headers.get("X-Accel-Redirect") == "@fail_open"
+    assert "boom" in r.headers.get("X-Banjax-Error", "")
+    # sanitized: the CRLF payload must not become its own header or split
+    # the response into a smuggled second one
+    assert "X-Injected" not in r.headers
+    assert "owned" in r.headers["X-Banjax-Error"]
+
+    # disarmed → the chain serves normally again on the same app
+    failpoints.disarm("decision_chain")
+    r = requests.get(
+        f"{BASE}/auth_request", params={"path": "/x"},
+        headers={"X-Client-IP": "3.3.3.3"}, timeout=5,
+    )
+    assert r.status_code == 200

@@ -1,0 +1,56 @@
+"""Failpoint mechanics: disarmed no-op, counted arming, spec parsing."""
+
+import pytest
+
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.resilience.failpoints import FaultInjected
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+
+
+def test_disarmed_is_a_noop():
+    failpoints.check("never.armed")  # must not raise
+
+
+def test_armed_raises_oserror_subclass():
+    failpoints.arm("x", message="boom")
+    with pytest.raises(FaultInjected) as ei:
+        failpoints.check("x")
+    assert isinstance(ei.value, OSError)  # the tailer's retry loop contract
+    assert "boom" in str(ei.value)
+
+
+def test_count_limits_fires_then_passes():
+    failpoints.arm("x", count=2)
+    for _ in range(2):
+        with pytest.raises(FaultInjected):
+            failpoints.check("x")
+    failpoints.check("x")  # exhausted → no-op
+    assert failpoints.fired_count("x") == 2
+    assert not failpoints.is_armed("x")
+
+
+def test_disarm_one_and_all():
+    failpoints.arm("a")
+    failpoints.arm("b")
+    failpoints.disarm("a")
+    failpoints.check("a")
+    with pytest.raises(FaultInjected):
+        failpoints.check("b")
+    failpoints.disarm()
+    failpoints.check("b")
+
+
+def test_spec_parsing_good_and_bad_entries():
+    failpoints.arm_from_spec(
+        "one=error:2; two ;bad=mode?; worse=error:xx;=skipme"
+    )
+    assert failpoints.is_armed("one")
+    assert failpoints.is_armed("two")  # bare name = unlimited error
+    assert not failpoints.is_armed("bad")
+    assert not failpoints.is_armed("worse")

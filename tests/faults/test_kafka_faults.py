@@ -1,0 +1,165 @@
+"""Kafka loop resilience: reconnect-with-backoff (intervals counted via an
+injected sleep), success reset, and the writer's no-report-lost contract
+across a transport failure."""
+
+import queue
+import random
+import threading
+import time
+
+from banjax_tpu.config.holder import ConfigHolder
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.ingest import reports
+from banjax_tpu.ingest.kafka_io import (
+    InMemoryTransport,
+    KafkaReader,
+    KafkaTransport,
+    KafkaWriter,
+)
+from banjax_tpu.resilience.backoff import Backoff
+from banjax_tpu.resilience.health import HealthRegistry, HealthStatus
+
+
+class _StaticHolder:
+    """ConfigHolder stand-in: a frozen config object."""
+
+    def __init__(self, config):
+        self._config = config
+
+    def get(self):
+        return self._config
+
+
+def _config():
+    from banjax_tpu.config.schema import config_from_yaml_text
+
+    return config_from_yaml_text(
+        "kafka_command_topic: cmd\nkafka_report_topic: rep\n"
+        "expiring_decision_ttl_seconds: 10\n"
+        "block_ip_ttl_seconds: 10\nblock_session_ttl_seconds: 10\n"
+    )
+
+
+class _ZeroRng(random.Random):
+    def random(self):
+        return 0.0
+
+
+class FlakyReadTransport(KafkaTransport):
+    """Raises on the first `fail_times` read attempts, then yields one
+    command and blocks until closed."""
+
+    def __init__(self, fail_times):
+        self.fail_times = fail_times
+        self.attempts = 0
+        self.delivered = threading.Event()
+        self._closed = threading.Event()
+
+    def read_messages(self, config, topic, partition):
+        self.attempts += 1
+        if self.attempts <= self.fail_times:
+            raise ConnectionError(f"broker down (attempt {self.attempts})")
+        yield b'{"Name": "challenge_ip", "Value": "1.2.3.4", "host": "h"}'
+        self.delivered.set()
+        while not self._closed.wait(0.02):
+            pass
+
+    def close(self):
+        self._closed.set()
+
+
+def test_reader_reconnects_with_capped_exponential_backoff():
+    sleeps = []
+
+    def fake_sleep(delay):
+        sleeps.append(delay)
+        return False  # "stop not set"
+
+    transport = FlakyReadTransport(fail_times=5)
+    backoff = Backoff(base=1.0, cap=4.0, factor=2.0, jitter=0.5,
+                      rng=_ZeroRng(), sleep=fake_sleep)
+    registry = HealthRegistry()
+    reader = KafkaReader(
+        _StaticHolder(_config()), DynamicDecisionLists(start_sweeper=False),
+        transport=transport, backoff=backoff,
+        health=registry.register("kafka-reader"),
+    )
+    reader.start()
+    assert transport.delivered.wait(5.0), "reader never recovered"
+    # delivered fires AFTER the reader processed the message, so the
+    # reset-on-success is observable before stop
+    attempt_after_delivery = backoff.attempt
+    status, _, _ = registry.get("kafka-reader").effective_status()
+    reader.stop()
+
+    # five failed connects → five sleeps, exponential then capped
+    assert sleeps[:5] == [1.0, 2.0, 4.0, 4.0, 4.0]
+    # delivery resets the backoff and reports healthy
+    assert attempt_after_delivery == 0
+    assert status == HealthStatus.HEALTHY
+
+
+def test_reader_health_degraded_while_reconnecting():
+    registry = HealthRegistry()
+    backoff = Backoff(base=0.01, cap=0.01, jitter=0.0)
+    reader = KafkaReader(
+        _StaticHolder(_config()), DynamicDecisionLists(start_sweeper=False),
+        transport=FlakyReadTransport(fail_times=10 ** 9),
+        backoff=backoff, health=registry.register("kafka-reader"),
+    )
+    reader.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        status, detail, _ = registry.get("kafka-reader").effective_status()
+        if status == HealthStatus.DEGRADED:
+            break
+        time.sleep(0.01)
+    reader.stop()
+    assert status == HealthStatus.DEGRADED
+    assert "reconnecting" in detail
+
+
+class FlakySendTransport(InMemoryTransport):
+    """send raises `fail_times` times, then records like the in-memory
+    transport."""
+
+    def __init__(self, fail_times):
+        super().__init__()
+        self.fail_times = fail_times
+        self.send_attempts = 0
+
+    def send(self, config, topic, value):
+        self.send_attempts += 1
+        if self.send_attempts <= self.fail_times:
+            raise ConnectionError("producer down")
+        super().send(config, topic, value)
+
+
+def test_writer_does_not_lose_inflight_report_across_send_failure():
+    # drain anything earlier tests left in the module-level queue
+    q = reports.get_message_queue()
+    while True:
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            break
+
+    sleeps = []
+    transport = FlakySendTransport(fail_times=3)
+    backoff = Backoff(base=0.5, cap=2.0, jitter=0.0,
+                      sleep=lambda d: (sleeps.append(d), False)[1])
+    writer = KafkaWriter(_StaticHolder(_config()), transport=transport,
+                         backoff=backoff)
+    for i in range(3):
+        q.put_nowait(f"report-{i}".encode())
+    writer.start()
+    deadline = time.time() + 5
+    while len(transport.sent) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    writer.stop()
+
+    # every report arrived exactly once, in order, despite three send
+    # crashes — the dequeued message is held and retried, never dropped
+    assert transport.sent == [b"report-0", b"report-1", b"report-2"]
+    # the three failures each cost one reconnect sleep (0.5, 1.0, 2.0)
+    assert sleeps[:3] == [0.5, 1.0, 2.0]

@@ -1,0 +1,68 @@
+"""Worker-crash healing: SIGKILL one SO_REUSEPORT worker via the
+supervisor's fault hook; the monitor must respawn it, requests must keep
+being served throughout, and the supervisor health component must recover."""
+
+import time
+from pathlib import Path
+
+import pytest
+import requests
+
+from banjax_tpu.native import shm
+from banjax_tpu.resilience.health import HealthStatus
+
+pytestmark = pytest.mark.skipif(
+    not shm.available(), reason="no C compiler for native shmstate"
+)
+
+BASE = "http://localhost:8081"
+_FIXTURES = Path(__file__).resolve().parent.parent / "fixtures"
+
+
+def test_kill_worker_respawns_and_health_recovers(app_factory, tmp_path):
+    custom = tmp_path / "banjax-config-kill.yaml"
+    custom.write_text(
+        (_FIXTURES / "banjax-config-test.yaml").read_text()
+        + "\nhttp_workers: 2\ndisable_kafka: true\n"
+    )
+    app = app_factory(str(custom))
+    sup = app._supervisor
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if all(p.poll() is None for p in sup._procs):
+            try:
+                requests.get(f"{BASE}/info", timeout=2)
+                break
+            except requests.RequestException:
+                pass
+        time.sleep(0.2)
+    assert all(p.poll() is None for p in sup._procs), "workers never started"
+
+    sup.kill_worker(0)  # SIGKILL: the OOM-kill shape
+
+    # requests keep flowing while one worker is down (the primary and the
+    # surviving worker still hold the SO_REUSEPORT socket)
+    for _ in range(5):
+        r = requests.get(
+            f"{BASE}/auth_request", params={"path": "/x"},
+            headers={"X-Client-IP": "4.4.4.4"}, timeout=5,
+        )
+        assert r.status_code == 200
+
+    # the monitor (1 s interval + 1 s respawn backoff) heals the slot
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if sup.respawn_count >= 1 and all(p.poll() is None for p in sup._procs):
+            break
+        time.sleep(0.2)
+    assert sup.respawn_count >= 1
+    assert all(p.poll() is None for p in sup._procs), "worker not respawned"
+
+    # supervisor health returns to HEALTHY once all workers are back
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        status, _, _ = app.health.get("worker-supervisor").effective_status()
+        if status == HealthStatus.HEALTHY:
+            break
+        time.sleep(0.2)
+    assert status == HealthStatus.HEALTHY

@@ -61,3 +61,44 @@ def test_parsed_request_header_lookup():
                          b"", True, b"")
     assert req.header("x-client-ip") == "1.2.3.4"
     assert req.header("missing") == ""
+
+
+def test_serialize_max_age_zero_matches_aiohttp_layout():
+    """Max-Age=0 (immediate expiry, e.g. a zero cookie-TTL config) must
+    reach the wire exactly like the aiohttp layout emits it — the old
+    `if c.max_age:` guard silently turned it into a session cookie
+    (ADVICE r5).  Differential against aiohttp's set_cookie."""
+    from banjax_tpu.httpapi.server import _to_web_response
+
+    resp = Response(cookies=[SetCookie(name="c", value="v", max_age=0)])
+    raw = serialize_response(resp, keep_alive=False)
+    line = [l for l in raw.split(b"\r\n") if l.startswith(b"Set-Cookie")][0]
+    assert b"Max-Age=0" in line
+
+    web_resp = _to_web_response(resp)
+    morsel = web_resp.cookies["c"]
+    assert morsel["max-age"] == "0"  # both layouts agree
+
+    # and None still omits the attribute on the fast layout
+    resp = Response(cookies=[SetCookie(name="c", value="v", max_age=None)])
+    raw = serialize_response(resp, keep_alive=False)
+    line = [l for l in raw.split(b"\r\n") if l.startswith(b"Set-Cookie")][0]
+    assert b"Max-Age" not in line
+
+
+def test_serialize_response_sanitizes_crlf_in_headers():
+    """Response-splitting guard: CR/LF in a header value (the fail-open
+    path's X-Banjax-Error carries raw exception text) must not break the
+    head apart (ADVICE r5)."""
+    raw = serialize_response(
+        Response(status=500, headers={
+            "X-Banjax-Error": "boom\r\nX-Injected: owned\r\n\r\nfake-body",
+        }),
+        keep_alive=False,
+    )
+    head, _, body = raw.partition(b"\r\n\r\n")
+    lines = head.split(b"\r\n")
+    assert not any(l.startswith(b"X-Injected") for l in lines)
+    err = [l for l in lines if l.startswith(b"X-Banjax-Error")][0]
+    assert b"boom" in err and b"owned" in err
+    assert body == b""
