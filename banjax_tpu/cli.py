@@ -152,6 +152,20 @@ class BanjaxApp:
 
         self._matcher = None
         self._matcher_generation = -1
+        # streaming pipeline scheduler (banjax_tpu/pipeline/): sits between
+        # the tailer and the matcher when enabled — overlapped stages,
+        # adaptive batch sizing, bounded backpressure, drain-time staleness.
+        # Disabled: _consume_lines keeps the reference-shaped synchronous
+        # per-batch path.
+        self.pipeline = None
+        if getattr(config, "pipeline_enabled", False):
+            from banjax_tpu.pipeline import PipelineScheduler
+
+            self.pipeline = PipelineScheduler.from_config(
+                matcher_getter=lambda: self._current_matcher()[1],
+                config=config,
+                health=self.health.register("pipeline"),
+            )
         self.tailer = LogTailer(
             config.server_log_file, self._consume_lines,
             health=self.health.register("tailer", stale_after=60.0),
@@ -169,6 +183,7 @@ class BanjaxApp:
             matcher_getter=lambda: self._matcher,
             supervisor_getter=lambda: self._supervisor,
             health=self.health,
+            pipeline_getter=lambda: self.pipeline,
         )
 
         gin_log_name = "gin.log" if config.standalone_testing else config.gin_log_file
@@ -220,6 +235,11 @@ class BanjaxApp:
         return cfg, self._matcher
 
     def _consume_lines(self, lines):
+        if self.pipeline is not None:
+            # asynchronous: results surface through the pipeline's drain
+            # stage; submit() applies bounded backpressure to the tailer
+            self.pipeline.submit(lines)
+            return None
         cfg, matcher = self._current_matcher()
         results = matcher.consume_lines(lines)
         if cfg.debug:
@@ -230,6 +250,8 @@ class BanjaxApp:
     def start_workers(self) -> None:
         """Launch tailer, Kafka, metrics, heartbeat (not the HTTP server)."""
         config = self.config_holder.get()
+        if self.pipeline is not None:
+            self.pipeline.start()
         self.tailer.start()
 
         if config.disable_kafka:
@@ -343,6 +365,9 @@ class BanjaxApp:
             self._supervisor.stop()
             self._supervisor = None
         self.tailer.stop()
+        if self.pipeline is not None:
+            # tailer first (no new admissions), then drain what's in flight
+            self.pipeline.stop()
         self.metrics.stop()
         # release the shm table only AFTER the metrics loop is stopped —
         # a late tick calling len(failed_challenge_states) on a released

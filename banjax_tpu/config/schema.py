@@ -199,10 +199,35 @@ class Config:
     # per-batch latency budget for the matcher in milliseconds; a batch
     # slower than this counts as a breaker failure. 0 disables the check.
     matcher_latency_budget_ms: float = 0.0
+    # optional rolling failure-rate window for the breaker: also trip when
+    # breaker_failure_threshold failures land within the last
+    # breaker_window_size outcomes even with successes interleaved (the
+    # flapping-device mode the consecutive counter misses). 0 = off.
+    breaker_window_size: int = 0
     # deterministic fault injection (resilience/failpoints.py): same spec
     # syntax as the BANJAX_FAILPOINTS env var, e.g.
     # "matcher.device=error:5;kafka.read=error". Empty = nothing armed.
     failpoints: str = ""
+    # --- streaming pipeline scheduler (banjax_tpu/pipeline/) ---
+    # Overlapped tailer→device→effector batching with adaptive sizing and
+    # backpressure; false = the reference-shaped synchronous per-batch
+    # consume path.
+    pipeline_enabled: bool = False
+    # bounded ring of in-flight batches; the encode stage blocks (and the
+    # admission buffer absorbs) when it is full
+    pipeline_ring_size: int = 4
+    # per-batch latency target the adaptive sizer steers toward (encode +
+    # device + drain, queueing excluded)
+    pipeline_latency_budget_ms: float = 250.0
+    # admission buffer bound in lines; beyond it the tailer blocks for
+    # pipeline_max_block_ms and then the OLDEST buffered lines are shed
+    # (counted in PipelineShedLines — bounded memory, never silent loss)
+    pipeline_buffer_lines: int = 131072
+    pipeline_max_block_ms: float = 250.0
+    # synthetic device probe through the idle pipeline every N seconds so
+    # a wedged device trips the breaker before the next burst; 0 = off
+    # (the default — standalone tests run without a probe thread)
+    matcher_probe_seconds: float = 0.0
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -238,7 +263,11 @@ _SCALAR_KEYS = {
     "matcher_native_parse": bool, "http_workers": int,
     "http_fast_path": bool,
     "breaker_failure_threshold": int, "breaker_recovery_seconds": float,
+    "breaker_window_size": int,
     "matcher_latency_budget_ms": float, "failpoints": str,
+    "pipeline_enabled": bool, "pipeline_ring_size": int,
+    "pipeline_latency_budget_ms": float, "pipeline_buffer_lines": int,
+    "pipeline_max_block_ms": float, "matcher_probe_seconds": float,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -342,6 +371,35 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
             "config keys breaker_recovery_seconds/matcher_latency_budget_ms: "
             f"expected non-negative, got {cfg.breaker_recovery_seconds}/"
             f"{cfg.matcher_latency_budget_ms}"
+        )
+    if cfg.breaker_window_size != 0 and (
+        cfg.breaker_window_size < cfg.breaker_failure_threshold
+    ):
+        raise ValueError(
+            "config key breaker_window_size: expected 0 (off) or >= "
+            f"breaker_failure_threshold ({cfg.breaker_failure_threshold}), "
+            f"got {cfg.breaker_window_size}"
+        )
+    if cfg.pipeline_ring_size < 1:
+        raise ValueError(
+            "config key pipeline_ring_size: expected >= 1, got "
+            f"{cfg.pipeline_ring_size}"
+        )
+    if cfg.pipeline_latency_budget_ms <= 0:
+        raise ValueError(
+            "config key pipeline_latency_budget_ms: expected positive, got "
+            f"{cfg.pipeline_latency_budget_ms}"
+        )
+    if cfg.pipeline_buffer_lines < 1:
+        raise ValueError(
+            "config key pipeline_buffer_lines: expected >= 1, got "
+            f"{cfg.pipeline_buffer_lines}"
+        )
+    if cfg.pipeline_max_block_ms < 0 or cfg.matcher_probe_seconds < 0:
+        raise ValueError(
+            "config keys pipeline_max_block_ms/matcher_probe_seconds: "
+            f"expected non-negative, got {cfg.pipeline_max_block_ms}/"
+            f"{cfg.matcher_probe_seconds}"
         )
 
     return cfg

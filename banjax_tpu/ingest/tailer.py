@@ -14,6 +14,13 @@ backoff instead of the reference's flat 5 s clock, the `tailer.open`
 failpoint injects deterministic open failures for the fault suite, and a
 health component heartbeats every poll iteration so a wedged tailer
 surfaces on /healthz.
+
+Backpressure: reads are bounded (READ_CHUNK_BYTES) so a multi-GB backlog
+after a stall arrives as a stream of bounded chunks instead of one giant
+string, and `on_lines` is allowed to BLOCK — the pipeline scheduler
+(banjax_tpu/pipeline/) uses that to apply bounded backpressure to this
+thread when its admission buffer is full.  While on_lines blocks, unread
+bytes simply stay in the file, which is the cheapest possible queue.
 """
 
 from __future__ import annotations
@@ -31,6 +38,9 @@ log = logging.getLogger(__name__)
 
 RETRY_SECONDS = 5  # regex_rate_limiter.go:47 — now the backoff cap
 POLL_SECONDS = 0.05
+# one read's upper bound: keeps a post-stall backlog from materializing as
+# a single unbounded string (and as one unbounded matcher batch)
+READ_CHUNK_BYTES = 4 << 20
 
 
 class LogTailer:
@@ -104,7 +114,7 @@ class LogTailer:
 
                 if self.health is not None:
                     self.health.beat()
-                chunk = f.read()
+                chunk = f.read(READ_CHUNK_BYTES)
                 if chunk:
                     buffer += chunk
                     # one split, not a split-per-line loop: the repeated

@@ -81,11 +81,16 @@ class TpuMatcher(Matcher):
         self.breaker = CircuitBreaker(
             failure_threshold=getattr(config, "breaker_failure_threshold", 3),
             recovery_seconds=getattr(config, "breaker_recovery_seconds", 30.0),
+            window_size=getattr(config, "breaker_window_size", 0),
             name="matcher-device",
         )
         self._latency_budget_s = (
             getattr(config, "matcher_latency_budget_ms", 0.0) or 0.0
         ) / 1e3
+        # when the config budget is unset, the pipeline scheduler installs
+        # a source deriving it from the measured device p99 (ROADMAP
+        # breaker-tuning item; obs/stats.py suggested_latency_budget_s)
+        self._latency_budget_source = None
         self.fallback_batches = 0  # batches served by the CPU fallback
         self._cpu_fallback = None
         self._health_registry = health
@@ -385,10 +390,8 @@ class TpuMatcher(Matcher):
                 )
                 self.breaker.record_failure()
                 return self._fallback_consume(lines, now_unix)
-            if (
-                self._latency_budget_s
-                and time.perf_counter() - t0 > self._latency_budget_s
-            ):
+            budget = self.effective_latency_budget_s()
+            if budget and time.perf_counter() - t0 > budget:
                 self.breaker.record_failure()
             else:
                 self.breaker.record_success()
@@ -396,6 +399,38 @@ class TpuMatcher(Matcher):
             return results
         finally:
             self.stats.record_batch(len(lines), time.perf_counter() - t0)
+
+    def effective_latency_budget_s(self) -> float:
+        """The breaker's per-batch latency budget: the configured
+        `matcher_latency_budget_ms` when set, else the pipeline-derived
+        value (3x EWMA device p99, floor 50 ms) when a scheduler has
+        installed a source, else 0 (budget check disabled)."""
+        if self._latency_budget_s:
+            return self._latency_budget_s
+        src = self._latency_budget_source
+        if src is None:
+            return 0.0
+        try:
+            return max(0.0, float(src()))
+        except Exception:  # noqa: BLE001 — a stats bug must not break consume
+            log.exception("latency budget source failed; budget disabled")
+            return 0.0
+
+    def set_latency_budget_source(self, fn) -> None:
+        self._latency_budget_source = fn
+
+    def note_device_outcome(self, elapsed_s: float, ok: bool) -> None:
+        """Breaker + health accounting for an externally-driven device
+        dispatch (the pipeline scheduler's submit/collect stages)."""
+        if not ok:
+            self.breaker.record_failure()
+        else:
+            budget = self.effective_latency_budget_s()
+            if budget and elapsed_s > budget:
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+        self._note_health()
 
     def _fallback_matcher(self):
         if self._cpu_fallback is None:
@@ -429,18 +464,17 @@ class TpuMatcher(Matcher):
                 f"breaker {state}; batches on CPU reference matcher",
             )
 
-    def _consume_lines_inner(
-        self, lines: Sequence[str], now_unix: Optional[float] = None
-    ) -> List[ConsumeLineResult]:
-        now = time.time() if now_unix is None else now_unix
-        results = LazyResults(len(lines))
-
-        # 1. host parse + allowlist exemption (regex_rate_limiter.go:131-172)
-        #    — one native C pass when available (banjax_tpu/native), with
-        #    the Python reference path per deferred line and as fallback.
-        #    The gate stays COLUMNAR (workset.py): flag masks, unique-
-        #    string tables, and a per-distinct-(host, ip) allowlist check,
-        #    so no per-line Python objects exist on the hot path.
+    def _gate(self, lines, now, results, use_scratch=True):
+        """Step 1: host parse + allowlist exemption
+        (regex_rate_limiter.go:131-172) — one native C pass when available
+        (banjax_tpu/native), with the Python reference path per deferred
+        line and as fallback.  The gate stays COLUMNAR (workset.py): flag
+        masks, unique-string tables, and a per-distinct-(host, ip)
+        allowlist check, so no per-line Python objects exist on the hot
+        path.  `use_scratch=False` (the pipeline split path) allocates
+        fresh parse/dedup buffers: with batches in flight concurrently,
+        batch N's work set must not alias buffers batch N+1's parse
+        reuses."""
         pre_encoded = None
         nb = None
         if self._native:
@@ -448,10 +482,13 @@ class TpuMatcher(Matcher):
 
             nb = native.parse_encode_batch(
                 lines, self.compiled.byte_to_class, self._max_len, now,
-                OLD_LINE_CUTOFF_SECONDS, scratch=self._parse_scratch,
+                OLD_LINE_CUTOFF_SECONDS,
+                scratch=self._parse_scratch if use_scratch else None,
             )
         if nb is not None:
-            work, pre_encoded = self._native_gate(nb, lines, now, results)
+            work, pre_encoded = self._native_gate(
+                nb, lines, now, results, use_scratch=use_scratch
+            )
         else:
             lw = ListWork()
             for i, text in enumerate(lines):
@@ -468,6 +505,16 @@ class TpuMatcher(Matcher):
                     continue
                 lw.append((i, p))
             work = lw
+        return work, pre_encoded
+
+    def _consume_lines_inner(
+        self, lines: Sequence[str], now_unix: Optional[float] = None
+    ) -> List[ConsumeLineResult]:
+        now = time.time() if now_unix is None else now_unix
+        results = LazyResults(len(lines))
+
+        # 1. host parse + allowlist exemption (see _gate)
+        work, pre_encoded = self._gate(lines, now, results)
         if not len(work):
             return results
 
@@ -497,11 +544,16 @@ class TpuMatcher(Matcher):
             self._apply_device_windows(work, bits, results)
             return results
 
-        # 3b. host window pass in original line order: per-site rules for the
-        #     line's host first, then global rules (regex_rate_limiter.go:175-211).
-        #     Lines with no match at all (the overwhelming majority) are
-        #     skipped wholesale; matched lines touch only their matched rule
-        #     ids, in order — O(matches), not O(lines × rules) Python.
+        # 3b. host window pass in original line order
+        self._apply_host_windows(work, bits, results)
+        return results
+
+    def _apply_host_windows(self, work, bits, results) -> None:
+        """Host window pass in original line order: per-site rules for the
+        line's host first, then global rules (regex_rate_limiter.go:175-211).
+        Lines with no match at all (the overwhelming majority) are
+        skipped wholesale; matched lines touch only their matched rule
+        ids, in order — O(matches), not O(lines × rules) Python."""
         row_any = bits.any(axis=1)
         for row in np.flatnonzero(row_any):
             i, p = work[int(row)]
@@ -518,10 +570,107 @@ class TpuMatcher(Matcher):
             except Exception:  # noqa: BLE001 — a failing effector loses one line, not the batch
                 log.exception("error applying rules to log line")
                 results[i].error = True
-        return results
 
     def close(self) -> None:
         """No buffered state: consume_lines is synchronous per batch."""
+
+    # ---- streaming-pipeline split protocol (pipeline/scheduler.py) ----
+    #
+    # consume_lines, split at its two natural seams so the scheduler can
+    # run the pieces on different stage threads: begin (host parse/gate/
+    # encode) → submit (device dispatch, no host sync) → collect (force
+    # device→host) → finish (window updates + Banner replay, which the
+    # scheduler serializes in admission order).  The fused matcher+windows
+    # single-dispatch path is bypassed here — it fuses the window apply
+    # into the device program, which cannot be deferred to the drain
+    # stage; the classic bitmap path it is differentially tested against
+    # is used instead.  Device windows themselves still work: apply_bitmap
+    # runs at finish, in admission order.
+
+    def pipeline_begin(self, lines: Sequence[str], now: float) -> dict:
+        """Encode stage: parse + gate + byte-class encode.  Fresh (non-
+        scratch) buffers — see _gate — because batches overlap in flight."""
+        results = LazyResults(len(lines))
+        work, pre_encoded = self._gate(
+            lines, now, results, use_scratch=False
+        )
+        return {
+            "lines": lines, "results": results, "work": work,
+            "pre": pre_encoded, "pend": None, "bits": None,
+        }
+
+    def pipeline_submit(self, state: dict) -> None:
+        if len(state["work"]):
+            state["pend"] = self._match_bits_submit(
+                state["work"], state["pre"]
+            )
+
+    def pipeline_collect(self, state: dict) -> None:
+        if state["pend"] is not None:
+            state["bits"] = self._match_bits_collect(state["pend"])
+
+    def pipeline_finish(self, state: dict, now: float):
+        """Drain stage: staleness re-check at EFFECTOR DRAIN time (the
+        reference's 10 s cutoff, regex_rate_limiter.go:164-167, applied
+        end-to-end — a line that aged out while queued in the pipeline is
+        dropped here, marked old_line, and counted), then the window pass
+        + Banner replay.  Returns (results, n_stale_dropped)."""
+        t0 = time.perf_counter()
+        results = state["results"]
+        work, bits = state["work"], state["bits"]
+        n_stale = 0
+        try:
+            if not len(work):
+                return results, 0
+            ages_s = now - work.ts_array() / 1e9
+            stale = ages_s > OLD_LINE_CUTOFF_SECONDS
+            if stale.any():
+                n_stale = int(stale.sum())
+                for k in np.flatnonzero(stale):
+                    i, _ = work[int(k)]
+                    r = results[i]
+                    r.old_line = True
+                    r.rule_results = []
+                keep = np.flatnonzero(~stale)
+                work = work.take(keep)
+                bits = bits[keep]
+                if not len(work):
+                    return results, n_stale
+            if self.device_windows is not None:
+                self._apply_device_windows(work, bits, results)
+            else:
+                self._apply_host_windows(work, bits, results)
+            self._note_health()
+            return results, n_stale
+        finally:
+            self.stats.record_batch(
+                len(state["lines"]), time.perf_counter() - t0
+            )
+
+    def probe(self, now_unix: Optional[float] = None) -> bool:
+        """Synthetic device probe (ROADMAP matcher-staleness item): one
+        canned line through the pure match path — no window updates, no
+        Banner effects — so a wedged device trips the breaker/health while
+        the tailer is idle, not at the next traffic burst.  Returns False
+        when the probe failed or the breaker refused it."""
+        if not self.breaker.allow():
+            return False
+        now = time.time() if now_unix is None else now_unix
+        line = (
+            f"{now:.6f} 203.0.113.1 GET banjax-probe.invalid "
+            "GET /__banjax_probe HTTP/1.1 probe -"
+        )
+        t0 = time.perf_counter()
+        try:
+            lw = ListWork()
+            lw.append((0, parse_line(line, now, OLD_LINE_CUTOFF_SECONDS)))
+            self._match_bits(lw, None)
+        except Exception:  # noqa: BLE001 — a probe failure is the signal, not a crash
+            log.exception("matcher device probe failed")
+            self.note_device_outcome(time.perf_counter() - t0, ok=False)
+            return False
+        self.note_device_outcome(time.perf_counter() - t0, ok=True)
+        return self.breaker.state == CLOSED
 
     def _slots_for_work(self, work) -> Optional[np.ndarray]:
         """Window-slot ids for a work batch: one LRU decision + one pin
@@ -534,13 +683,15 @@ class TpuMatcher(Matcher):
             return None
         return uslots[uinv]
 
-    def _native_gate(self, nb, lines, now, results):
+    def _native_gate(self, nb, lines, now, results, use_scratch=True):
         """Vectorized step 1 over a native ParsedBatch: flag masks, unique
         ip/host tables (workset.unique_spans), allowlist per DISTINCT
         (host, ip) with a snapshot-keyed cache, and a columnar NativeWork.
         Semantics identical to the per-line reference loop; cost is
         O(distinct strings + matched rows), not O(lines)."""
         from banjax_tpu import native
+
+        dedup_scratch = self._dedup_scratch if use_scratch else None
 
         n = nb.n
         flags = np.asarray(nb.flags[:n])
@@ -582,12 +733,12 @@ class TpuMatcher(Matcher):
         ips_u, ip_inv_v = unique_spans(
             nb.ip_off[vrows], nb.ip_len[vrows],
             lambda k: nb.ip(int(vrows[k])),
-            blob=nb.blob, text=text, dedup_scratch=self._dedup_scratch,
+            blob=nb.blob, text=text, dedup_scratch=dedup_scratch,
         )
         hosts_u, host_inv_v = unique_spans(
             nb.host_off[vrows], nb.host_len[vrows],
             lambda k: nb.host(int(vrows[k])),
-            blob=nb.blob, text=text, dedup_scratch=self._dedup_scratch,
+            blob=nb.blob, text=text, dedup_scratch=dedup_scratch,
         )
         ip_inv = np.empty(cand.size, dtype=np.int64)
         host_inv = np.empty(cand.size, dtype=np.int64)
@@ -1036,40 +1187,75 @@ class TpuMatcher(Matcher):
         materialize only for host-fallback rows. The fused prefilter
         consumes it directly — its plan is built against THIS matcher's
         byte classes (build_plan byte_classes=...), so the one encode
-        feeds stage 1, stage 2, and the single-stage fallback."""
+        feeds stage 1, stage 2, and the single-stage fallback.
+
+        Split into submit (device dispatch, no host sync) and collect
+        (force device→host + host fallbacks) so the streaming pipeline
+        scheduler can hide batch N's pull behind batch N+1's compute."""
+        return self._match_bits_collect(
+            self._match_bits_submit(work, pre_encoded)
+        )
+
+    def _match_bits_submit(self, work, pre_encoded=None) -> dict:
+        """Dispatch the device match for a work batch without forcing any
+        device→host transfer; `_match_bits_collect` completes it."""
         failpoints.check("matcher.device")
         n = len(work)
         rests = (
             None if pre_encoded is not None
             else [p.rest for _, p in work]
         )
+        cls_ids, lens, host_eval = pre_encoded or encode_for_match(
+            self.compiled, rests, self._max_len
+        )
+        device_rows = np.flatnonzero(~host_eval)
+        pend = {
+            "n": n, "work": work, "rests": rests, "cls": cls_ids,
+            "lens": lens, "host_eval": host_eval, "device_rows": device_rows,
+        }
+        if self._prefilter is not None:
+            # host_eval rows are decided by host `re` in collect; zeroing
+            # their length keeps them out of the device bitmap w/o a gather
+            dev_lens = np.where(host_eval, 0, lens)
+            # submit every chunk before collecting any: each chunk's
+            # device→host pull (fixed ~65 ms tunnel latency) overlaps
+            # the next chunk's compute
+            pend["kind"] = "prefilter"
+            pend["chunks"] = [
+                (sl, self._prefilter.submit(cls_ids[sl], dev_lens[sl]))
+                for sl in (
+                    slice(s, min(n, s + self._max_batch))
+                    for s in range(0, n, self._max_batch)
+                )
+            ]
+        elif self._mesh_matcher is not None:
+            # the mesh backend's match_bits is synchronous; run it in
+            # collect so submit stays cheap and non-blocking
+            pend["kind"] = "mesh"
+        else:
+            pend["kind"] = "single"
+            pend["chunks"] = self._single_stage_submit(
+                cls_ids, lens, device_rows
+            )
+        return pend
+
+    def _match_bits_collect(self, pend: dict) -> np.ndarray:
+        """Force the submitted match to a host [N, n_rules] bitmap and run
+        the host fallback passes (over-length lines; unlowerable rules)."""
+        n = pend["n"]
+        work, rests = pend["work"], pend["rests"]
+        cls_ids, lens = pend["cls"], pend["lens"]
+        host_eval, device_rows = pend["host_eval"], pend["device_rows"]
 
         def rest_of(row: int) -> str:
             return work[row][1].rest if rests is None else rests[row]
 
-        if self._prefilter is not None:
+        if pend["kind"] == "prefilter":
             from banjax_tpu.matcher.prefilter import PrefilterOverflow
 
-            cls_ids, lens, host_eval = pre_encoded or encode_for_match(
-                self.compiled, rests, self._max_len
-            )
-            # host_eval rows are decided by host `re` below; zeroing their
-            # length keeps them out of the device bitmap without a gather
-            dev_lens = np.where(host_eval, 0, lens)
-            device_rows = np.flatnonzero(~host_eval)
             try:
                 bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
-                # submit every chunk before collecting any: each chunk's
-                # device→host pull (fixed ~65 ms tunnel latency) overlaps
-                # the next chunk's compute
-                pend = [
-                    (sl, self._prefilter.submit(cls_ids[sl], dev_lens[sl]))
-                    for sl in (
-                        slice(s, min(n, s + self._max_batch))
-                        for s in range(0, n, self._max_batch)
-                    )
-                ]
-                for sl, p in pend:
+                for sl, p in pend["chunks"]:
                     bits[sl] = self._prefilter.collect(p)
                 # a zero-length row must contribute NO device bits (the
                 # empty_only always-rule reconstruction keys on lens == 0,
@@ -1082,12 +1268,8 @@ class TpuMatcher(Matcher):
                 bits = self._single_stage_bits(
                     n, cls_ids, lens, host_eval, device_rows
                 )
-        elif self._mesh_matcher is not None:
-            cls_ids, lens, host_eval = pre_encoded or encode_for_match(
-                self.compiled, rests, self._max_len
-            )
+        elif pend["kind"] == "mesh":
             bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
-            device_rows = np.flatnonzero(~host_eval)
             # chunk by max_batch like the single-device path, so one huge
             # tailer burst can't compile an outsized one-off program
             for start in range(0, len(device_rows), self._max_batch):
@@ -1096,13 +1278,7 @@ class TpuMatcher(Matcher):
                     cls_ids[rows], lens[rows]
                 )
         else:
-            cls_ids, lens, host_eval = pre_encoded or encode_for_match(
-                self.compiled, rests, self._max_len
-            )
-            device_rows = np.flatnonzero(~host_eval)
-            bits = self._single_stage_bits(
-                n, cls_ids, lens, host_eval, device_rows
-            )
+            bits = self._single_stage_collect(n, pend["chunks"])
 
         # host fallback: whole lines the device can't decide
         for row in np.flatnonzero(host_eval):
@@ -1118,12 +1294,11 @@ class TpuMatcher(Matcher):
                     bits[row, idx] = 1
         return bits
 
-    def _single_stage_bits(
-        self, n: int, cls_ids, lens, host_eval, device_rows
-    ) -> np.ndarray:
-        """Full-NFA match bitmap for the single-device path (also the
-        prefilter's overflow fallback — it has no capacity to exceed)."""
-        bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
+    def _single_stage_submit(self, cls_ids, lens, device_rows) -> list:
+        """Dispatch the full-NFA match per max_batch chunk; the returned
+        device arrays are NOT forced — collect does that, so a caller can
+        overlap this batch's pull with the next batch's compute."""
+        chunks = []
         for start in range(0, len(device_rows), self._max_batch):
             rows = device_rows[start : start + self._max_batch]
             b = _bucket(len(rows), self._max_batch)
@@ -1137,14 +1312,29 @@ class TpuMatcher(Matcher):
                     interpret=self._pallas_interpret, packed=True,
                 )
             else:
-                packed = np.asarray(
-                    nfa_jax.match_batch_packed(
-                        self._params, pad_cls, pad_len, self.compiled.n_rules
-                    )
+                packed = nfa_jax.match_batch_packed(
+                    self._params, pad_cls, pad_len, self.compiled.n_rules
                 )
-            out = np.unpackbits(packed, axis=1, count=self.compiled.n_rules)
+            chunks.append((rows, packed))
+        return chunks
+
+    def _single_stage_collect(self, n: int, chunks: list) -> np.ndarray:
+        bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
+        for rows, packed in chunks:
+            out = np.unpackbits(
+                np.asarray(packed), axis=1, count=self.compiled.n_rules
+            )
             bits[rows] = out[: len(rows)]
         return bits
+
+    def _single_stage_bits(
+        self, n: int, cls_ids, lens, host_eval, device_rows
+    ) -> np.ndarray:
+        """Full-NFA match bitmap for the single-device path (also the
+        prefilter's overflow fallback — it has no capacity to exceed)."""
+        return self._single_stage_collect(
+            n, self._single_stage_submit(cls_ids, lens, device_rows)
+        )
 
     def _rule_pos(self, host: str) -> Dict[int, int]:
         """{rule id -> its position in the host's per-site-then-global

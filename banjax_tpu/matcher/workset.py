@@ -136,6 +136,17 @@ class NativeWork:
         for k in range(len(self.rows)):
             yield self[k]
 
+    def take(self, idx) -> "NativeWork":
+        """Arbitrary-row subset (index array) — same table-sharing
+        semantics as slicing; the pipeline's drain-time staleness filter
+        uses this to drop aged-out rows before the window pass."""
+        idx = np.asarray(idx, dtype=np.int64)
+        return NativeWork(
+            self.nb, self.rows[idx], self.ips_u, self.ip_inv[idx],
+            self.hosts_u, self.host_inv[idx], self.ts_ns[idx],
+            self.defer_map,
+        )
+
     def unique_ips(self) -> Tuple[List[str], np.ndarray]:
         """(distinct ips present in THIS view, per-row inverse). Compacts
         the shared table so a slice never allocates (and pins) window
@@ -195,6 +206,10 @@ class ListWork(list):
         if isinstance(k, slice):
             return ListWork(super().__getitem__(k))
         return super().__getitem__(k)
+
+    def take(self, idx) -> "ListWork":
+        """Arbitrary-row subset (index array) — NativeWork.take parity."""
+        return ListWork(list.__getitem__(self, int(i)) for i in idx)
 
 
 def unique_spans(

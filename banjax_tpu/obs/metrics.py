@@ -27,6 +27,17 @@ from banjax_tpu.decisions.rate_limit import (
 REPORT_INTERVAL_SECONDS = 29  # banjax.go:196
 
 
+def _kafka_skipped_batches() -> int:
+    """Lazy import: the metrics line must not pay a kafka import (or fail)
+    when kafka is disabled."""
+    try:
+        from banjax_tpu.ingest import kafka_wire
+
+        return kafka_wire.skipped_batch_count()
+    except Exception:  # noqa: BLE001 — metrics must never take the reporter down
+        return 0
+
+
 def write_metrics_line(
     out: TextIO,
     dynamic_lists: DynamicDecisionLists,
@@ -35,6 +46,7 @@ def write_metrics_line(
     matcher=None,
     supervisor=None,
     health=None,
+    pipeline=None,
 ) -> None:
     challenges, blocks = dynamic_lists.metrics()
     line = {
@@ -50,6 +62,16 @@ def write_metrics_line(
                 getattr(matcher, "device_windows", None), matcher
             )
         )
+    if pipeline is not None:
+        # streaming pipeline scheduler: per-stage EWMA latencies, queue
+        # depths, shed/stale counters (banjax_tpu/pipeline/scheduler.py)
+        line.update(pipeline.snapshot())
+    # Kafka batches skipped for an undecodable codec (lz4/zstd — VERDICT
+    # C17): surfaced only when nonzero so the reference's exact key set is
+    # preserved on clean streams
+    skipped = _kafka_skipped_batches()
+    if skipped:
+        line["KafkaSkippedBatches"] = skipped
     if supervisor is not None:
         # multi-worker serving health: nonzero respawns = workers crashed
         # and were healed (httpapi/workers.py monitor)
@@ -81,6 +103,7 @@ class MetricsReporter:
         matcher_getter: Optional[Callable[[], object]] = None,
         supervisor_getter: Optional[Callable[[], object]] = None,
         health=None,
+        pipeline_getter: Optional[Callable[[], object]] = None,
     ):
         self.log_path = log_path
         self.dynamic_lists = dynamic_lists
@@ -91,6 +114,7 @@ class MetricsReporter:
         self.matcher_getter = matcher_getter
         self.supervisor_getter = supervisor_getter
         self.health = health
+        self.pipeline_getter = pipeline_getter
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -110,8 +134,11 @@ class MetricsReporter:
                 supervisor = (
                     self.supervisor_getter() if self.supervisor_getter else None
                 )
+                pipeline = (
+                    self.pipeline_getter() if self.pipeline_getter else None
+                )
                 write_metrics_line(
                     out, self.dynamic_lists, self.regex_states,
                     self.failed_challenge_states, matcher, supervisor,
-                    self.health,
+                    self.health, pipeline,
                 )

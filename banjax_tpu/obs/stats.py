@@ -14,6 +14,7 @@ import time
 from typing import Dict, Optional
 
 _LATENCY_RING = 512  # recent batch latencies kept for the percentiles
+_DEVICE_RING = 256   # recent device-stage latencies for the pipeline p99
 
 
 class MatcherStats:
@@ -99,3 +100,106 @@ class MatcherStats:
                     matcher, "fallback_batches", 0
                 )
         return out
+
+
+class PipelineStats:
+    """Thread-safe counters for the streaming pipeline scheduler
+    (banjax_tpu/pipeline/scheduler.py).
+
+    The accounting invariant the fault suite asserts: after a flush,
+    admitted_lines == processed_lines + shed_lines + drain_error_lines —
+    every admitted line is either processed (a result was produced for
+    it, old_line included) or counted as shed; nothing is silent.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.admitted_lines = 0
+        self.processed_lines = 0
+        self.shed_lines = 0         # oldest-first overload shed
+        self.drain_error_lines = 0  # drain-stage failures, counted as shed
+        self.stale_dropped_lines = 0  # aged past cutoff inside the pipeline
+        self.batches = 0
+        self.fallback_batches = 0   # drained generically via consume_lines
+        self.probe_ok = 0
+        self.probe_failed = 0
+        self._device_ring = [0.0] * _DEVICE_RING
+        self._device_n = 0
+        self._device_p99_ewma: Optional[float] = None
+
+    def note_admitted(self, n: int) -> None:
+        with self._lock:
+            self.admitted_lines += n
+
+    def note_processed(self, n: int) -> None:
+        with self._lock:
+            self.processed_lines += n
+
+    def note_shed(self, n: int) -> None:
+        with self._lock:
+            self.shed_lines += n
+
+    def note_drain_error(self, n: int) -> None:
+        with self._lock:
+            self.drain_error_lines += n
+
+    def note_stale(self, n: int) -> None:
+        with self._lock:
+            self.stale_dropped_lines += n
+
+    def note_batch(self, fallback: bool) -> None:
+        with self._lock:
+            self.batches += 1
+            if fallback:
+                self.fallback_batches += 1
+
+    def note_probe(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self.probe_ok += 1
+            else:
+                self.probe_failed += 1
+
+    def observe_device(self, elapsed_s: float) -> None:
+        """One device-stage (submit→collect) wall time; feeds the p99 the
+        breaker-budget satellite derives `matcher_latency_budget_ms` from."""
+        with self._lock:
+            self._device_ring[self._device_n % _DEVICE_RING] = elapsed_s
+            self._device_n += 1
+            n = min(self._device_n, _DEVICE_RING)
+            lats = sorted(self._device_ring[:n])
+            p99 = lats[min(n - 1, (n * 99) // 100)]
+            self._device_p99_ewma = (
+                p99 if self._device_p99_ewma is None
+                else self._device_p99_ewma + 0.2 * (p99 - self._device_p99_ewma)
+            )
+
+    def device_p99_s(self) -> Optional[float]:
+        with self._lock:
+            return self._device_p99_ewma
+
+    def suggested_latency_budget_s(self) -> float:
+        """Derived breaker budget: 3x the EWMA device p99, floored at
+        50 ms (ROADMAP breaker-tuning item).  0.0 until a p99 exists —
+        the breaker treats 0 as 'no budget', same as the unset config."""
+        with self._lock:
+            if self._device_p99_ewma is None:
+                return 0.0
+            return max(0.05, 3.0 * self._device_p99_ewma)
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            p99 = self._device_p99_ewma
+            return {
+                "PipelineAdmittedLines": self.admitted_lines,
+                "PipelineProcessedLines": self.processed_lines,
+                "PipelineShedLines": self.shed_lines,
+                "PipelineDrainErrorLines": self.drain_error_lines,
+                "PipelineStaleDroppedLines": self.stale_dropped_lines,
+                "PipelineBatches": self.batches,
+                "PipelineFallbackBatches": self.fallback_batches,
+                "PipelineProbeFailures": self.probe_failed,
+                "PipelineDeviceP99Ms": (
+                    None if p99 is None else round(p99 * 1e3, 3)
+                ),
+            }

@@ -1,0 +1,14 @@
+"""Streaming pipeline scheduler (SURVEY §7.2 M5).
+
+The subsystem between the ingest tailer and the matcher: turns the
+per-batch synchronous submit→wait→collect path into a multi-stage
+overlapped pipeline with adaptive batch sizing, bounded backpressure,
+and drain-time staleness accounting.  See pipeline/scheduler.py for the
+stage/ordering contract and pipeline/sizer.py for the batch sizing
+policy.
+"""
+
+from banjax_tpu.pipeline.scheduler import PipelineScheduler
+from banjax_tpu.pipeline.sizer import AdaptiveBatchSizer
+
+__all__ = ["PipelineScheduler", "AdaptiveBatchSizer"]

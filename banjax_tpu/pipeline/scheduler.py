@@ -1,0 +1,474 @@
+"""Streaming pipeline scheduler: overlapped tailer→device→effector batching.
+
+PERF.md's transport finding: the fused matcher classifies 2.57M lines/s
+device-resident but only ~135–206k end-to-end, because consume_lines is a
+synchronous submit→wait→collect loop and the ~65 ms fixed device→host
+latency is only hidden when overlapped with compute.  This module is the
+continuous-batching scheduler that closes that gap — the inference-serving
+pattern (SURVEY §7.2 M5) applied to log classification.
+
+Stages, one thread each::
+
+    tailer → submit() → [admission buffer]
+        → encode  (batch formation at the adaptive target, host
+                   parse/gate/encode — matcher.pipeline_begin)
+        → device  (h2d + device dispatch — matcher.pipeline_submit — with
+                   up to two batches in flight, so batch N's device→host
+                   pull (pipeline_collect) hides behind batch N+1's
+                   compute)
+        → drain   (strictly FIFO: window updates, Banner effects,
+                   staleness accounting — matcher.pipeline_finish)
+
+so batch N+1 encodes and uploads while batch N computes and batch N−1
+drains.
+
+Ordering contract: the drain stage is a single thread consuming batches
+in admission order, so per-(ip, rule) window updates and ban-log lines
+stay in log order across batch boundaries — byte-identical to the
+synchronous path (tests/differential/test_pipeline_differential.py).
+
+Batch sizing: pipeline/sizer.py grows/shrinks the encode target within
+power-of-two buckets to hit `pipeline_latency_budget_ms` from observed
+per-stage EWMA timings, replacing the fixed `matcher_batch_lines` guess.
+
+Backpressure: a bounded ring of in-flight batches (`pipeline_ring_size`)
+gates the encode stage; when the ring is full the admission buffer
+absorbs up to `pipeline_buffer_lines`, beyond which submit() blocks the
+tailer for at most `pipeline_max_block_ms` and then sheds OLDEST lines
+first, counting every shed line (PipelineShedLines) — bounded memory,
+never silent loss.
+
+Staleness: the reference drops lines older than 10 s at consume time
+(regex_rate_limiter.go:164-167).  Here age is measured at *effector
+drain* time — a line that ages out while queued is dropped exactly as
+the reference would have dropped it, marked old_line in its result, and
+counted (PipelineStaleDroppedLines).
+
+Resilience: matchers without the split protocol (CpuMatcher), batches
+whose device stage failed, and batches admitted while the breaker is
+OPEN all drain generically through matcher.consume_lines — which routes
+to the CPU reference matcher under an open breaker — so the ring drains
+through the CPU fallback and no admitted line is lost.  Failpoints
+pipeline.encode / pipeline.submit / pipeline.collect / pipeline.drain
+cover each stage boundary; the scheduler registers as a health
+component; and an optional timer probe (`matcher_probe_seconds`) pushes
+a synthetic batch through the idle device path so a wedged device trips
+the breaker before the next traffic burst.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+from banjax_tpu.obs.stats import PipelineStats
+from banjax_tpu.pipeline.sizer import AdaptiveBatchSizer
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.resilience.breaker import OPEN
+
+log = logging.getLogger(__name__)
+
+
+class _Batch:
+    __slots__ = ("lines", "matcher", "state", "t_encode_ms", "t_device_ms",
+                 "t0_device")
+
+    def __init__(self, lines: List[str]):
+        self.lines = lines
+        self.matcher = None
+        self.state = None       # split-protocol state; None = generic drain
+        self.t_encode_ms = 0.0
+        self.t_device_ms = 0.0
+        self.t0_device = 0.0
+
+
+class PipelineScheduler:
+    def __init__(
+        self,
+        matcher_getter: Callable[[], object],
+        ring_size: int = 4,
+        latency_budget_ms: float = 250.0,
+        buffer_lines: int = 131072,
+        max_block_ms: float = 250.0,
+        min_batch: int = 64,
+        max_batch: int = 16384,
+        probe_seconds: float = 0.0,
+        health=None,
+        on_results: Optional[Callable[[List[str], Optional[list]], None]] = None,
+        now_fn: Callable[[], float] = time.time,
+    ):
+        if ring_size < 1:
+            raise ValueError(f"ring_size must be >= 1, got {ring_size}")
+        if buffer_lines < 1:
+            raise ValueError(f"buffer_lines must be >= 1, got {buffer_lines}")
+        self._matcher_getter = matcher_getter
+        self.ring_size = ring_size
+        self.buffer_lines = buffer_lines
+        self.max_block_s = max(0.0, max_block_ms) / 1e3
+        self.probe_seconds = probe_seconds
+        self._health = health
+        self._on_results = on_results
+        self._now_fn = now_fn
+        self._sizer = AdaptiveBatchSizer(
+            latency_budget_ms, min_batch=min_batch, max_batch=max_batch
+        )
+        self.stats = PipelineStats()
+        self._buf: deque = deque()
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._last_activity = time.monotonic()
+        self._ring = threading.Semaphore(ring_size)
+        self._q_dev: "queue.Queue" = queue.Queue()
+        self._q_drain: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    @classmethod
+    def from_config(cls, matcher_getter, config, health=None, on_results=None):
+        return cls(
+            matcher_getter,
+            ring_size=getattr(config, "pipeline_ring_size", 4),
+            latency_budget_ms=getattr(
+                config, "pipeline_latency_budget_ms", 250.0
+            ),
+            buffer_lines=getattr(config, "pipeline_buffer_lines", 131072),
+            max_block_ms=getattr(config, "pipeline_max_block_ms", 250.0),
+            max_batch=max(64, getattr(config, "matcher_batch_lines", 16384)),
+            probe_seconds=getattr(config, "matcher_probe_seconds", 0.0),
+            health=health,
+            on_results=on_results,
+        )
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        loops = [
+            ("pipeline-encode", self._encode_loop),
+            ("pipeline-device", self._device_loop),
+            ("pipeline-drain", self._drain_loop),
+        ]
+        if self.probe_seconds > 0:
+            loops.append(("pipeline-probe", self._probe_loop))
+        for name, fn in loops:
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        if self._health is not None:
+            self._health.ok()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Drain everything already admitted, then stop the stage threads
+        (bounded by ring_size + buffer_lines, both finite by contract)."""
+        with self._cond:
+            self._stop.set()
+            self._cond.notify_all()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(max(0.1, deadline - time.monotonic()))
+        self._threads = []
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Block until every admitted line has drained (tests/bench)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._buf or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+        return True
+
+    # ---- admission (tailer thread) ----
+
+    def submit(self, lines: Sequence[str]) -> None:
+        """Admit a chunk of log lines.  Blocks for at most
+        `pipeline_max_block_ms` when the buffer is full, then sheds
+        oldest-first — the tailer is never blocked unboundedly and memory
+        is never unbounded."""
+        lines = list(lines)
+        if not lines:
+            return
+        self.stats.note_admitted(len(lines))
+        deadline: Optional[float] = None
+        with self._cond:
+            self._last_activity = time.monotonic()
+            while (
+                len(self._buf) + len(lines) > self.buffer_lines
+                and not self._stop.is_set()
+            ):
+                if deadline is None:
+                    deadline = time.monotonic() + self.max_block_s
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            overflow = len(self._buf) + len(lines) - self.buffer_lines
+            if overflow > 0:
+                # sustained overload: oldest-first shed, every line counted
+                dropped = 0
+                while overflow > 0 and self._buf:
+                    self._buf.popleft()
+                    overflow -= 1
+                    dropped += 1
+                if overflow > 0:  # chunk alone exceeds the buffer bound
+                    lines = lines[overflow:]
+                    dropped += overflow
+                self.stats.note_shed(dropped)
+                if self._health is not None:
+                    self._health.degraded(f"overload: shed {dropped} lines")
+            was_empty = not self._buf
+            self._buf.extend(lines)
+            if was_empty:
+                # the encode thread only sleeps on an empty buffer; waking
+                # it per chunk would burn the tailer thread on notify calls
+                # at high submit rates (flush/backpressure waiters are woken
+                # by the encode/drain stages, not here)
+                self._cond.notify_all()
+
+    # ---- encode stage ----
+
+    def _encode_loop(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    while not self._buf and not self._stop.is_set():
+                        self._cond.wait(0.2)
+                    if not self._buf and self._stop.is_set():
+                        return
+                # reserve a ring slot OUTSIDE the lock: while the ring is
+                # full the admission buffer keeps absorbing (and shedding)
+                # instead of the tailer blocking on a held condition
+                if not self._ring.acquire(timeout=0.2):
+                    continue
+                with self._cond:
+                    # take whatever is buffered up to the target, never
+                    # wait for a fuller batch: holding the ring slot while
+                    # the buffer fills starves the device stage (measured
+                    # −40% on the 1-core box); partial batches are fine —
+                    # the sizer's trickle rule ignores them
+                    take = min(len(self._buf), self._sizer.target())
+                    lines = [self._buf.popleft() for _ in range(take)]
+                    if lines:
+                        self._inflight += 1
+                    self._cond.notify_all()
+                if not lines:  # a shed emptied the buffer under us
+                    self._ring.release()
+                    continue
+                self._q_dev.put(self._encode_batch(lines))
+        finally:
+            self._q_dev.put(None)
+
+    def _encode_batch(self, lines: List[str]) -> _Batch:
+        batch = _Batch(lines)
+        t0 = time.perf_counter()
+        matcher = self._matcher_getter()
+        batch.matcher = matcher
+        breaker = getattr(matcher, "breaker", None)
+        # breaker OPEN: skip the split encode entirely — the generic drain
+        # re-parses inside consume_lines, which routes to the CPU fallback
+        if hasattr(matcher, "pipeline_begin") and not (
+            breaker is not None and breaker.state == OPEN
+        ):
+            if hasattr(matcher, "set_latency_budget_source"):
+                # breaker-budget satellite: when matcher_latency_budget_ms
+                # is unset the breaker derives it from this pipeline's
+                # observed device p99 (3x EWMA p99, floor 50 ms)
+                matcher.set_latency_budget_source(
+                    self.stats.suggested_latency_budget_s
+                )
+            try:
+                failpoints.check("pipeline.encode")
+                batch.state = matcher.pipeline_begin(lines, self._now_fn())
+            except Exception:  # noqa: BLE001 — encode failure → generic drain, no loss
+                log.exception(
+                    "pipeline encode stage failed; batch drains generically"
+                )
+                batch.state = None
+        batch.t_encode_ms = (time.perf_counter() - t0) * 1e3
+        return batch
+
+    # ---- device stage ----
+
+    def _device_loop(self) -> None:
+        pending: deque = deque()  # submitted, awaiting collect (≤ 2)
+        try:
+            while True:
+                if pending:
+                    # something is in flight: only take new work that is
+                    # already queued; otherwise collect now — the overlap
+                    # only pays when a successor batch exists to compute
+                    # behind the pull
+                    try:
+                        batch = self._q_dev.get_nowait()
+                    except queue.Empty:
+                        self._collect(pending.popleft())
+                        continue
+                else:
+                    batch = self._q_dev.get()
+                if batch is None:
+                    while pending:
+                        self._collect(pending.popleft())
+                    return
+                if batch.state is not None:
+                    breaker = getattr(batch.matcher, "breaker", None)
+                    if breaker is not None and not breaker.allow():
+                        batch.state = None  # generic drain → CPU fallback
+                    else:
+                        batch.t0_device = time.perf_counter()
+                        try:
+                            failpoints.check("pipeline.submit")
+                            batch.matcher.pipeline_submit(batch.state)
+                            # submit half of the device time; collect adds
+                            # its half (NOT wall-from-submit: with depth-2
+                            # overlap that would double-count the gap where
+                            # the successor batch submits)
+                            batch.t_device_ms = (
+                                time.perf_counter() - batch.t0_device
+                            ) * 1e3
+                        except Exception:  # noqa: BLE001 — device failure → fallback drain
+                            log.exception(
+                                "pipeline submit stage failed; batch drains "
+                                "on the CPU reference path"
+                            )
+                            self._device_failure(batch)
+                        else:
+                            pending.append(batch)
+                            # keep ≤ 2 in flight: collect the older batch
+                            # while this one computes
+                            while len(pending) >= 2:
+                                self._collect(pending.popleft())
+                            continue
+                # generic/failed batches keep FIFO order: everything
+                # submitted before them must reach the drain queue first
+                while pending:
+                    self._collect(pending.popleft())
+                self._q_drain.put(batch)
+        finally:
+            self._q_drain.put(None)
+
+    def _collect(self, batch: _Batch) -> None:
+        t0 = time.perf_counter()
+        try:
+            failpoints.check("pipeline.collect")
+            batch.matcher.pipeline_collect(batch.state)
+        except Exception:  # noqa: BLE001 — device failure → fallback drain
+            log.exception(
+                "pipeline collect stage failed; batch drains on the CPU "
+                "reference path"
+            )
+            self._device_failure(batch)
+        else:
+            batch.t_device_ms += (time.perf_counter() - t0) * 1e3
+            self.stats.observe_device(batch.t_device_ms / 1e3)
+            note = getattr(batch.matcher, "note_device_outcome", None)
+            if note is not None:
+                note(batch.t_device_ms / 1e3, ok=True)
+        self._q_drain.put(batch)
+
+    def _device_failure(self, batch: _Batch) -> None:
+        batch.state = None
+        batch.t_device_ms = max(
+            batch.t_device_ms, (time.perf_counter() - batch.t0_device) * 1e3
+        )
+        note = getattr(batch.matcher, "note_device_outcome", None)
+        if note is not None:
+            note(batch.t_device_ms / 1e3, ok=False)
+
+    # ---- drain stage (admission order — the ordering contract) ----
+
+    def _drain_loop(self) -> None:
+        while True:
+            batch = self._q_drain.get()
+            if batch is None:
+                return
+            t0 = time.perf_counter()
+            n = len(batch.lines)
+            results = None
+            ok = True
+            try:
+                failpoints.check("pipeline.drain")
+                now = self._now_fn()
+                if batch.state is None:
+                    # generic path: full consume_lines semantics, including
+                    # the breaker's CPU-reference fallback — never a loss
+                    results = batch.matcher.consume_lines(batch.lines, now)
+                    self.stats.note_batch(fallback=True)
+                else:
+                    results, n_stale = batch.matcher.pipeline_finish(
+                        batch.state, now
+                    )
+                    if n_stale:
+                        self.stats.note_stale(n_stale)
+                    self.stats.note_batch(fallback=False)
+            except Exception:  # noqa: BLE001 — drain failure is counted, never silent
+                ok = False
+                log.exception(
+                    "pipeline drain stage failed; %d lines counted as shed", n
+                )
+                self.stats.note_drain_error(n)
+                if self._health is not None:
+                    self._health.degraded("drain failure; lines shed")
+            if ok:
+                self.stats.note_processed(n)
+                if self._health is not None:
+                    self._health.ok()
+            t_drain_ms = (time.perf_counter() - t0) * 1e3
+            self._sizer.observe(n, {
+                "encode": batch.t_encode_ms,
+                "device": batch.t_device_ms,
+                "drain": t_drain_ms,
+            })
+            if self._on_results is not None:
+                try:
+                    self._on_results(batch.lines, results)
+                except Exception:  # noqa: BLE001 — an observer must not stall the drain
+                    log.exception("pipeline on_results callback failed")
+            self._ring.release()
+            with self._cond:
+                self._inflight -= 1
+                self._last_activity = time.monotonic()
+                self._cond.notify_all()
+
+    # ---- idle probe (matcher staleness satellite) ----
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_seconds):
+            with self._cond:
+                idle = (
+                    not self._buf
+                    and self._inflight == 0
+                    and time.monotonic() - self._last_activity
+                    >= self.probe_seconds
+                )
+            if not idle:
+                continue
+            probe = getattr(self._matcher_getter(), "probe", None)
+            if probe is None:
+                continue
+            try:
+                probe_ok = bool(probe())
+            except Exception:  # noqa: BLE001 — a probe bug must not kill the timer
+                log.exception("pipeline device probe raised")
+                probe_ok = False
+            self.stats.note_probe(probe_ok)
+            if self._health is not None:
+                if probe_ok:
+                    self._health.ok()
+                else:
+                    self._health.degraded("device probe failed")
+
+    # ---- observability ----
+
+    def snapshot(self) -> dict:
+        """Additive 29 s metrics-line keys (obs/metrics.py)."""
+        out = self.stats.snapshot()
+        out.update(self._sizer.snapshot())
+        with self._cond:
+            out["PipelineBufferedLines"] = len(self._buf)
+            out["PipelineInflightBatches"] = self._inflight
+        out["PipelineRingSize"] = self.ring_size
+        return out

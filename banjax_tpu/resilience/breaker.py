@@ -8,12 +8,21 @@ Trips after `failure_threshold` consecutive failures — a device dispatch
 raising, or a batch breaching the latency budget — so a wedged TPU
 degrades throughput instead of dropping log lines.  The clock is
 injectable for deterministic recovery tests.
+
+Optionally (`window_size > 0`) a rolling failure-rate window runs
+alongside the consecutive counter: the breaker also trips when
+`failure_threshold` failures land within the last `window_size`
+recorded outcomes, even when successes are interleaved — the flapping-
+device mode a consecutive counter never catches (ROADMAP breaker-tuning
+item).  The window clears on every trip so a recovered breaker starts
+from a clean history.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 CLOSED = "closed"
@@ -32,17 +41,25 @@ class CircuitBreaker:
         clock: Callable[[], float] = time.monotonic,
         name: str = "",
         on_trip: Optional[Callable[[str], None]] = None,
+        window_size: int = 0,
     ):
         if failure_threshold < 1:
             raise ValueError(f"failure_threshold must be >= 1, got {failure_threshold}")
+        if window_size < 0:
+            raise ValueError(f"window_size must be >= 0, got {window_size}")
         self.failure_threshold = failure_threshold
         self.recovery_seconds = recovery_seconds
+        self.window_size = window_size
         self.name = name
         self._clock = clock
         self._on_trip = on_trip
         self._lock = threading.Lock()
         self._state = CLOSED
         self._failures = 0
+        # rolling outcome window (True = failure); None when disabled
+        self._window: Optional[deque] = (
+            deque(maxlen=window_size) if window_size > 0 else None
+        )
         self._opened_at = 0.0
         self._probe_in_flight = False
         self.trip_count = 0
@@ -75,6 +92,8 @@ class CircuitBreaker:
             self._failures = 0
             self._probe_in_flight = False
             self._state = CLOSED
+            if self._window is not None:
+                self._window.append(False)
 
     def record_failure(self) -> None:
         tripped = False
@@ -87,10 +106,20 @@ class CircuitBreaker:
                 tripped = True
             else:
                 self._failures += 1
-                if self._state == CLOSED and self._failures >= self.failure_threshold:
+                if self._window is not None:
+                    self._window.append(True)
+                window_failures = (
+                    sum(self._window) if self._window is not None else 0
+                )
+                if self._state == CLOSED and (
+                    self._failures >= self.failure_threshold
+                    or window_failures >= self.failure_threshold
+                ):
                     self._state = OPEN
                     self._opened_at = self._clock()
                     self.trip_count += 1
                     tripped = True
+            if tripped and self._window is not None:
+                self._window.clear()
         if tripped and self._on_trip is not None:
             self._on_trip(self.name)

@@ -18,6 +18,13 @@ Instrumented sites in this tree:
   tailer.open      — LogTailer, every file open (start and rotation)
   matcher.device   — TpuMatcher, every device dispatch boundary
   decision_chain   — decision_for_nginx entry (fail-open path)
+  pipeline.encode  — pipeline scheduler, encode-stage boundary (a failing
+                     batch drains generically; no loss)
+  pipeline.submit  — pipeline scheduler, device submit boundary (breaker
+                     failure + CPU-reference drain)
+  pipeline.collect — pipeline scheduler, device collect boundary (same)
+  pipeline.drain   — pipeline scheduler, drain-stage boundary (the batch's
+                     lines are counted as shed, never silently lost)
 """
 
 from __future__ import annotations

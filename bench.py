@@ -40,6 +40,13 @@ Env knobs: BENCH_CPU=1 forces the host backend; BENCH_NO_LADDER=1 skips the
 ladder; BENCH_BUDGET_S caps worker wall time (default 480 s) — sections
 past the deadline are skipped and marked; BENCH_SECTIONS=a,b runs only
 those sections (worker dev loop).
+
+Streaming modes: `bench.py --sync` / `bench.py --pipeline` measure the
+end-to-end tailer-shaped feed through the synchronous consume path vs
+the streaming pipeline scheduler (banjax_tpu/pipeline/), emit the same
+one-line JSON schema, and merge both rows (plus the speedup) into
+BENCH_pipeline.json.  Knobs: BENCH_STREAM_{RULES,LINES,CHUNK,BUDGET_MS},
+BENCH_CPU=1 for the host backend.
 """
 
 from __future__ import annotations
@@ -772,6 +779,152 @@ def worker_main(backend: str, budget_s: float, only: "list | None") -> None:
 
 
 # ---------------------------------------------------------------------------
+# streaming modes: --pipeline vs --sync (the scheduler's acceptance bench)
+# ---------------------------------------------------------------------------
+
+STREAM_PATH = os.path.join(_DIR, "BENCH_pipeline.json")
+
+
+def _stream_mode(mode: str) -> None:
+    """End-to-end throughput of the tailer→matcher path under a
+    tailer-shaped feed.
+
+    `feed_chunk_lines` models ARRIVAL granularity: the reference consumes
+    per line (regex_rate_limiter.go:58-76); a poll-based tailer keeping up
+    with its stream delivers small reads (default 16 lines — one 50 ms
+    poll at moderate rate).  The two modes consume the identical chunk
+    stream:
+
+    --sync     : the pre-pipeline behavior — one synchronous
+                 consume_lines call per arriving chunk, so batch size is
+                 COUPLED to arrival granularity and every chunk pays the
+                 full submit→wait→collect fixed cost.
+    --pipeline : the same chunks through banjax_tpu/pipeline/ — the
+                 scheduler coalesces arrivals into adaptive batches
+                 (decoupling batch size from arrival granularity, the
+                 continuous-batching move) and overlaps
+                 encode/device/drain across its stage threads.
+
+    Emits one JSON line in the BENCH_r0x schema and merges the row into
+    BENCH_pipeline.json (plus the pipeline/sync speedup once both modes
+    have run on the same backend).
+    """
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import yaml as _yaml
+
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from banjax_tpu.pipeline import PipelineScheduler
+    from tests.mock_banner import MockBanner
+
+    backend = jax.devices()[0].platform
+    n_rules = int(os.environ.get("BENCH_STREAM_RULES", str(N_RULES)))
+    total = int(os.environ.get(
+        "BENCH_STREAM_LINES", "131072" if backend == "tpu" else "32768"
+    ))
+    feed_chunk = int(os.environ.get("BENCH_STREAM_CHUNK", "16"))
+    budget_ms = float(os.environ.get("BENCH_STREAM_BUDGET_MS", "180"))
+
+    patterns = generate_rules(n_rules)
+    rules_yaml = _yaml.safe_dump({
+        "regexes_with_rates": [
+            {"rule": f"crs{i}", "regex": p, "interval": 60,
+             "hits_per_interval": 50, "decision": "nginx_block"}
+            for i, p in enumerate(patterns)
+        ]
+    })
+    cfg = config_from_yaml_text(rules_yaml)
+    banner = MockBanner()
+    matcher = TpuMatcher(
+        cfg, banner, StaticDecisionLists(cfg), RegexRateLimitStates()
+    )
+    now = time.time()
+    rests = generate_lines(total, patterns, seed=43)
+    lines = [
+        f"{now:.6f} 10.8.{(i % 2048) >> 8}.{i % 256} {r}"
+        for i, r in enumerate(rests)
+    ]
+    chunks = [lines[i : i + feed_chunk] for i in range(0, total, feed_chunk)]
+
+    out = {
+        "metric": f"log-lines/sec end-to-end, tailer-shaped feed ({mode})",
+        "unit": "lines/sec",
+        "mode": mode,
+        "backend": backend,
+        "n_rules": n_rules,
+        "n_lines": total,
+        "feed_chunk_lines": feed_chunk,
+        "latency_budget_ms": budget_ms,
+    }
+    if mode == "sync":
+        matcher.consume_lines(chunks[0], now)  # warm compile at the chunk bucket
+        t0 = time.perf_counter()
+        for c in chunks:
+            matcher.consume_lines(c, now)
+        elapsed = time.perf_counter() - t0
+    else:
+        sched = PipelineScheduler(
+            lambda: matcher, latency_budget_ms=budget_ms,
+            buffer_lines=max(131072, total), now_fn=lambda: now,
+        )
+        sched.start()
+        # warm pass: compiles every bucket the sizer will settle through,
+        # so the timed pass measures steady state, not Mosaic/XLA compiles
+        for c in chunks:
+            sched.submit(c)
+        assert sched.flush(600), "pipeline warm pass did not drain"
+        t0 = time.perf_counter()
+        for c in chunks:
+            sched.submit(c)
+        assert sched.flush(600), "pipeline timed pass did not drain"
+        elapsed = time.perf_counter() - t0
+        snap = sched.snapshot()
+        sched.stop()
+        out["pipeline_batch_target"] = snap.get("PipelineBatchTarget")
+        out["pipeline_batches"] = snap.get("PipelineBatches")
+        out["pipeline_shed_lines"] = snap.get("PipelineShedLines")
+        out["pipeline_stale_dropped"] = snap.get("PipelineStaleDroppedLines")
+        out["pipeline_device_p99_ms"] = snap.get("PipelineDeviceP99Ms")
+        for k in ("Encode", "Device", "Drain"):
+            out[f"pipeline_stage_{k.lower()}_ewma_ms"] = snap.get(
+                f"PipelineStage{k}EwmaMs"
+            )
+    lps = total / elapsed
+    out["value"] = round(lps, 1)
+    out["vs_baseline"] = round(lps / TARGET, 4)
+    out["elapsed_s"] = round(elapsed, 2)
+
+    # merge into BENCH_pipeline.json (atomic) and report the speedup when
+    # both modes have been measured on this backend
+    try:
+        with open(STREAM_PATH) as f:
+            book = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        book = {}
+    book[mode] = out
+    other = book.get("pipeline" if mode == "sync" else "sync")
+    if other and other.get("backend") == backend and other.get("value"):
+        pipe = out["value"] if mode == "pipeline" else other["value"]
+        sync = out["value"] if mode == "sync" else other["value"]
+        book["pipeline_vs_sync_speedup"] = round(pipe / sync, 2)
+        out["pipeline_vs_sync_speedup"] = book["pipeline_vs_sync_speedup"]
+    tmp = STREAM_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(book, f, indent=1)
+    os.replace(tmp, STREAM_PATH)
+
+    head = ["metric", "value", "unit", "vs_baseline", "backend", "mode"]
+    ordered = {k: out[k] for k in head if k in out}
+    ordered.update({k: v for k, v in out.items() if k not in ordered})
+    print(json.dumps(ordered))
+
+
+# ---------------------------------------------------------------------------
 # supervisor
 # ---------------------------------------------------------------------------
 
@@ -825,6 +978,12 @@ def _compose(partial: dict, live_sections: "set", probe: str,
 
 
 def main() -> None:
+    if "--pipeline" in sys.argv:
+        _stream_mode("pipeline")
+        return
+    if "--sync" in sys.argv:
+        _stream_mode("sync")
+        return
     if "--worker" in sys.argv:
         backend = "cpu"
         if "--backend" in sys.argv:

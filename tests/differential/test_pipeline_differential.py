@@ -1,0 +1,175 @@
+"""Pipelined vs synchronous path: byte-identical outputs under random
+batch-size churn (the tentpole's ordering contract).
+
+The pipelined scheduler must produce, for the same line stream:
+  * the identical ConsumeLineResult stream, in admission order;
+  * byte-identical ban-log lines (the real Banner writing to in-memory
+    files, not just the mock's tuples);
+  * identical dynamic-list decisions and rate-limit window state —
+even while the adaptive sizer is replaced with an adversarial one that
+picks a random batch size per take, so batch boundaries land everywhere.
+"""
+
+import io
+import random
+import threading
+import time
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.effectors.banner import Banner
+from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from banjax_tpu.matcher.runner import TpuMatcher
+from banjax_tpu.pipeline import PipelineScheduler
+from banjax_tpu.pipeline.sizer import AdaptiveBatchSizer
+from tests.differential.test_tpu_matcher import CONFIG_YAML, result_key
+from tests.mock_banner import MockBanner
+
+
+class ChurnSizer(AdaptiveBatchSizer):
+    """Adversarial sizing: a random power-of-two-ish target per take, so
+    batch boundaries fall at every possible offset of the stream."""
+
+    def __init__(self, seed: int):
+        super().__init__(budget_ms=1000.0)
+        self._rng = random.Random(seed)
+
+    def target(self) -> int:
+        return self._rng.choice([1, 2, 3, 5, 8, 13, 32, 64, 100, 256])
+
+
+def _gen_lines(n, now, seed=5):
+    rng = random.Random(seed)
+    lines = []
+    for i in range(n):
+        kind = rng.random()
+        ip = f"1.2.{rng.randrange(4)}.{rng.randrange(6)}"
+        if kind < 0.08:
+            lines.append(f"{now:f} {ip} POST example.com POST /submit HTTP/1.1 ua -")
+        elif kind < 0.3:
+            lines.append(f"{now:f} {ip} GET example.com GET /page{i % 7} HTTP/1.1 ua -")
+        elif kind < 0.38:
+            lines.append(f"{now:f} {ip} GET per-site.com GET /blockme HTTP/1.1 ua -")
+        elif kind < 0.45:
+            lines.append(f"{now:f} {ip} DELETE skipme.com DELETE /x HTTP/1.1 ua -")
+        elif kind < 0.5:
+            lines.append(f"{now:f} 12.12.12.12 GET example.com GET /allowed HTTP/1.1 ua -")
+        elif kind < 0.54:
+            lines.append("short garbage")
+        elif kind < 0.58:
+            lines.append(f"{now - 100:f} {ip} GET example.com GET /old HTTP/1.1 ua -")
+        else:
+            lines.append(f"{now:f} {ip} GET news.net GET /benign/{i % 11} HTTP/1.1 ua -")
+    return lines
+
+
+def _build(matcher_cls, device_windows=False):
+    """One matcher over its own fresh state with the REAL Banner writing
+    ban logs into StringIO — the byte-identical comparison surface."""
+    config = config_from_yaml_text(CONFIG_YAML)
+    config.matcher_device_windows = device_windows
+    states = RegexRateLimitStates()
+    ban_log = io.StringIO()
+    ban_log_temp = io.StringIO()
+    dyn = DynamicDecisionLists(start_sweeper=False)
+    banner = Banner(dyn, ban_log, ban_log_temp, ipset_instance=None)
+    matcher = matcher_cls(config, banner, StaticDecisionLists(config), states)
+    return matcher, states, dyn, ban_log
+
+
+@pytest.mark.parametrize("device_windows", [False, True])
+def test_pipelined_stream_is_byte_identical_to_sync(device_windows):
+    now = time.time()
+    lines = _gen_lines(1500, now)
+
+    # oracle 1: the CPU reference, line at a time
+    cpu, cpu_states, cpu_dyn, cpu_log = _build(CpuMatcher)
+    cpu_results = [cpu.consume_line(l, now_unix=now) for l in lines]
+
+    # oracle 2: the synchronous TPU batch path
+    sync, sync_states, sync_dyn, sync_log = _build(TpuMatcher, device_windows)
+    sync_results = sync.consume_lines(lines, now_unix=now)
+
+    # the pipelined path with adversarial batch churn
+    pipe, pipe_states, pipe_dyn, pipe_log = _build(TpuMatcher, device_windows)
+    collected = []
+    lock = threading.Lock()
+
+    def sink(batch_lines, results):
+        with lock:
+            collected.append((batch_lines, results))
+
+    sched = PipelineScheduler(
+        lambda: pipe, on_results=sink, now_fn=lambda: now
+    )
+    sched._sizer = ChurnSizer(seed=99)
+    sched.start()
+    rng = random.Random(17)
+    i = 0
+    while i < len(lines):
+        step = rng.randrange(1, 120)
+        sched.submit(lines[i : i + step])
+        i += step
+    assert sched.flush(120)
+    sched.stop()
+
+    pipe_lines = [l for ls, _ in collected for l in ls]
+    pipe_results = [r for _, rs in collected for r in rs]
+    assert pipe_lines == lines, "admission order broken across batches"
+    assert len(pipe_results) == len(lines)
+
+    for i, (c, s, p) in enumerate(
+        zip(cpu_results, sync_results, pipe_results)
+    ):
+        assert result_key(c) == result_key(s), f"sync diverged at line {i}"
+        assert result_key(c) == result_key(p), f"pipeline diverged at line {i}"
+
+    # ban-log BYTES and dynamic-list decisions, against both oracles
+    assert pipe_log.getvalue() == cpu_log.getvalue()
+    assert pipe_log.getvalue() == sync_log.getvalue()
+    assert pipe_dyn.metrics() == cpu_dyn.metrics()
+
+    # rate-limit window state (host dict or device counters)
+    cpu_view = cpu_states.format_states()
+    sync_view = (
+        sync.device_windows if device_windows else sync_states
+    ).format_states()
+    pipe_view = (
+        pipe.device_windows if device_windows else pipe_states
+    ).format_states()
+    assert cpu_view == sync_view == pipe_view
+
+    # nothing shed, nothing stale in a fixed-now run
+    snap = sched.snapshot()
+    assert snap["PipelineShedLines"] == 0
+    assert snap["PipelineStaleDroppedLines"] == 0
+    assert snap["PipelineProcessedLines"] == len(lines)
+
+
+def test_repeated_streams_accumulate_identically():
+    """Window state spans batches and streams: feeding the same stream
+    twice through the pipeline must equal feeding it twice synchronously
+    (exceeded-counters keep counting, in order)."""
+    now = time.time()
+    lines = _gen_lines(400, now, seed=23)
+
+    sync, sync_states, _, sync_log = _build(TpuMatcher)
+    sync.consume_lines(lines, now_unix=now)
+    sync.consume_lines(lines, now_unix=now)
+
+    pipe, pipe_states, _, pipe_log = _build(TpuMatcher)
+    sched = PipelineScheduler(lambda: pipe, now_fn=lambda: now)
+    sched._sizer = ChurnSizer(seed=3)
+    sched.start()
+    for _ in range(2):
+        for i in range(0, len(lines), 37):
+            sched.submit(lines[i : i + 37])
+    assert sched.flush(120)
+    sched.stop()
+
+    assert pipe_log.getvalue() == sync_log.getvalue()
+    assert pipe_states.format_states() == sync_states.format_states()

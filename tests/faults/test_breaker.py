@@ -71,3 +71,77 @@ def test_on_trip_callback_and_validation():
     assert trips == ["x"]
     with pytest.raises(ValueError):
         CircuitBreaker(failure_threshold=0)
+
+
+# --------------------------------------------------- rolling window (PR 2)
+
+
+def test_window_trips_on_interleaved_failures():
+    """threshold 3 in a 6-outcome window: F S F S F trips even though the
+    consecutive counter keeps resetting — the flapping-device mode."""
+    clk = Clock()
+    br = CircuitBreaker(failure_threshold=3, recovery_seconds=10.0,
+                        clock=clk, window_size=6)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_success()
+    assert br.state == CLOSED
+    br.record_failure()
+    assert br.state == OPEN
+    assert br.trip_count == 1
+
+
+def test_without_window_interleaved_failures_never_trip():
+    br, _ = make(threshold=3)
+    for _ in range(10):
+        br.record_failure()
+        br.record_success()
+    assert br.state == CLOSED
+
+
+def test_old_failures_age_out_of_the_window():
+    """A window of 4: a failure pushed out by successes no longer counts,
+    but two failures landing within the window (non-consecutively) trip."""
+    clk = Clock()
+    br = CircuitBreaker(failure_threshold=2, recovery_seconds=10.0,
+                        clock=clk, window_size=4)
+    br.record_failure()
+    for _ in range(4):
+        br.record_success()  # the failure is now outside the window
+    br.record_failure()  # window [S,S,S,F]: 1 failure → no trip
+    assert br.state == CLOSED
+    br.record_success()
+    br.record_failure()  # window [S,F,S,F]: 2 within 4, non-consecutive
+    assert br.state == OPEN
+
+
+def test_window_clears_on_trip_so_recovery_starts_clean():
+    clk = Clock()
+    br = CircuitBreaker(failure_threshold=2, recovery_seconds=10.0,
+                        clock=clk, window_size=4)
+    br.record_failure()
+    br.record_failure()
+    assert br.state == OPEN
+    clk.t = 10.0
+    assert br.allow()  # half-open probe
+    br.record_success()
+    assert br.state == CLOSED
+    # one failure after recovery: the pre-trip history must not count
+    br.record_failure()
+    assert br.state == CLOSED
+
+
+def test_window_size_validation():
+    with pytest.raises(ValueError):
+        CircuitBreaker(window_size=-1)
+    from banjax_tpu.config.schema import config_from_yaml_text
+
+    with pytest.raises(ValueError):
+        config_from_yaml_text(
+            "breaker_failure_threshold: 3\nbreaker_window_size: 2\n"
+        )
+    cfg = config_from_yaml_text(
+        "breaker_failure_threshold: 3\nbreaker_window_size: 8\n"
+    )
+    assert cfg.breaker_window_size == 8

@@ -1,0 +1,66 @@
+"""The pipeline scheduler wired into the real app: tailer → scheduler →
+matcher → Banner, plus the health and metrics surfaces (cli.py wiring).
+"""
+
+import json
+import time
+
+import requests
+
+BASE = "http://localhost:8081"
+
+
+def _append_log(path, lines):
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("".join(l + "\n" for l in lines))
+
+
+def test_pipeline_enabled_app_end_to_end(app_factory, tmp_path):
+    app = app_factory("banjax-config-test-pipeline.yaml")
+    assert app.pipeline is not None
+
+    # the real tailer follows the standalone log file from EOF
+    assert app.tailer.opened.wait(5)
+    now = time.time()
+    _append_log(
+        "testing-log-file.txt",
+        [
+            f"{now:.6f} 44.44.44.{i} GET example.com GET /blockme "
+            "HTTP/1.1 ua -"
+            for i in range(40)
+        ],
+    )
+
+    # the instant-block rule must ban every IP through the async pipeline
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if app.pipeline.stats.processed_lines >= 40:
+            break
+        time.sleep(0.05)
+    assert app.pipeline.stats.processed_lines >= 40
+    challenges, blocks = app.dynamic_lists.metrics()
+    assert challenges + blocks == 40
+
+    # pipeline is a health component on /healthz
+    r = requests.get(f"{BASE}/healthz", timeout=5)
+    assert r.status_code == 200
+    assert "pipeline" in r.json()["components"]
+
+    # and its counters ride the metrics line
+    snap = app.pipeline.snapshot()
+    assert snap["PipelineAdmittedLines"] >= 40
+    assert snap["PipelineShedLines"] == 0
+
+    from io import StringIO
+
+    from banjax_tpu.obs.metrics import write_metrics_line
+
+    out = StringIO()
+    write_metrics_line(
+        out, app.dynamic_lists, app.regex_states,
+        app.failed_challenge_states, app._matcher, None, app.health,
+        app.pipeline,
+    )
+    line = json.loads(out.getvalue())
+    assert line["PipelineProcessedLines"] >= 40
+    assert line["Health_pipeline"] == "healthy"

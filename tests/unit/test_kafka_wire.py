@@ -258,3 +258,127 @@ def test_gzip_compressed_batches_decode():
     batch = _struct.pack(">ibI", -1, 2, crc) + after_crc
     full = _struct.pack(">qi", 0, len(batch)) + batch
     assert _decode_record_batches(full) == [(0, b"zipped")]
+
+
+# ---------------------------------------------------------------- snappy (C17)
+
+
+@pytest.fixture
+def _snappy_counter():
+    kafka_wire.reset_skipped_batches()
+    yield
+    kafka_wire.reset_skipped_batches()
+
+
+def _record_batch_with_codec(payload: bytes, attrs: int, n_records: int = 1):
+    import struct as _struct
+
+    after_crc = _struct.pack(
+        ">hiqqqhii", attrs, 0, 0, 0, -1, -1, -1, n_records
+    ) + payload
+    crc = crc32c(after_crc)
+    batch = _struct.pack(">ibI", -1, 2, crc) + after_crc
+    return _struct.pack(">qi", 0, len(batch)) + batch
+
+
+# a PRE-ENCODED snappy fixture (not produced by our own encoder): literal
+# "abcd" + an overlapping back-copy (len 12, offset 4) — the RLE idiom —
+# decoding to b"abcdabcdabcdabcd"
+SNAPPY_FIXTURE = bytes([16, (4 - 1) << 2]) + b"abcd" + bytes(
+    [((12 - 1) << 2) | 2, 4, 0]
+)
+
+
+def test_snappy_raw_block_fixture_decodes():
+    assert kafka_wire.snappy_decompress(SNAPPY_FIXTURE) == b"abcdabcdabcdabcd"
+
+
+def test_snappy_copy1_and_long_literal_forms():
+    # copy with 1-byte offset (tag kind 1): literal "abcdefgh" then
+    # copy(len=4, offset=8) -> "abcdefghabcd"
+    block = bytes([12, (8 - 1) << 2]) + b"abcdefgh" + bytes(
+        [((4 - 4) << 2) | 1, 8]
+    )
+    assert kafka_wire.snappy_decompress(block) == b"abcdefghabcd"
+    # 2-byte literal length form (upper-6-bits 61)
+    data = bytes(range(256)) * 2
+    block = kafka_wire.snappy_compress(data)
+    assert kafka_wire.snappy_decompress(block) == data
+
+
+def test_snappy_roundtrip_through_compressor():
+    for payload in (b"", b"x", b"hello snappy " * 50, bytes(range(256)) * 300):
+        assert kafka_wire.snappy_decompress(
+            kafka_wire.snappy_compress(payload)
+        ) == payload
+
+
+def test_snappy_truncated_and_bad_offset_raise():
+    with pytest.raises(kafka_wire.KafkaWireError):
+        kafka_wire.snappy_decompress(bytes([16, (8 - 1) << 2]) + b"ab")
+    # copy offset beyond what has been produced
+    with pytest.raises(kafka_wire.KafkaWireError):
+        kafka_wire.snappy_decompress(
+            bytes([8, (2 - 1) << 2]) + b"ab" + bytes([((4 - 4) << 2) | 1, 99])
+        )
+
+
+def test_snappy_record_batch_v2_decodes(_snappy_counter):
+    record_body = (b"\x00" + _varint(0) + _varint(0) + _varint(-1) +
+                   _varint(7) + b"snapped" + _varint(0))
+    record = _varint(len(record_body)) + record_body
+    full = _record_batch_with_codec(kafka_wire.snappy_compress(record), attrs=2)
+    assert _decode_record_batches(full) == [(0, b"snapped")]
+    assert kafka_wire.skipped_batch_count() == 0
+
+
+def test_snappy_xerial_framed_message_set_decodes(_snappy_counter):
+    import struct as _struct
+
+    inner = _encode_message_set_v1(b"old-snappy", 1234, offset=5)
+    raw = kafka_wire.snappy_compress(inner)
+    framed = (b"\x82SNAPPY\x00" + _struct.pack(">ii", 1, 1)
+              + _struct.pack(">i", len(raw)) + raw)
+    wrapper = _encode_message_set_v1(framed, 1234, offset=5)
+    # flip the wrapper's attrs byte to codec 2 (offset: 8 offset + 4 size
+    # + 4 crc + 1 magic = attrs at byte 17)
+    wrapper = wrapper[:17] + bytes([2]) + wrapper[18:]
+    assert _decode_message_set(wrapper) == [(5, b"old-snappy")]
+    assert kafka_wire.skipped_batch_count() == 0
+
+
+def test_lz4_zstd_batches_are_counted_not_silently_dropped(_snappy_counter):
+    for attrs, codec in ((3, "lz4"), (4, "zstd")):
+        full = _record_batch_with_codec(b"\x00\x01\x02", attrs=attrs)
+        assert _decode_record_batches(full) == []
+    assert kafka_wire.skipped_batch_count() == 2
+
+
+def test_corrupt_snappy_batch_is_counted_not_fatal(_snappy_counter):
+    full = _record_batch_with_codec(b"\xff\xff\xff\xff", attrs=2)
+    assert _decode_record_batches(full) == []  # skipped, not raised
+    assert kafka_wire.skipped_batch_count() == 1
+
+
+def test_skipped_batches_surface_on_metrics_line(_snappy_counter):
+    import io as _io
+    import json as _json
+
+    from banjax_tpu.decisions.rate_limit import (
+        FailedChallengeRateLimitStates,
+        RegexRateLimitStates,
+    )
+    from banjax_tpu.obs.metrics import write_metrics_line
+
+    def metrics_line():
+        out = _io.StringIO()
+        write_metrics_line(
+            out, DynamicDecisionLists(start_sweeper=False),
+            RegexRateLimitStates(), FailedChallengeRateLimitStates(),
+        )
+        return _json.loads(out.getvalue())
+
+    # clean stream: the reference's exact key set, no additive key
+    assert "KafkaSkippedBatches" not in metrics_line()
+    _decode_record_batches(_record_batch_with_codec(b"\x00", attrs=3))
+    assert metrics_line()["KafkaSkippedBatches"] == 1
