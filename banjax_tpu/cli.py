@@ -254,6 +254,13 @@ class BanjaxApp:
             self.pipeline.start()
         self.tailer.start()
 
+        # kafka→pipeline routing: command messages share the pipeline's
+        # admission buffer (bounded-block/oldest-first shed, drained in
+        # admission order) when the scheduler runs — ROADMAP PR 2 item
+        kafka_pipeline = (
+            self.pipeline
+            if getattr(config, "pipeline_kafka", True) else None
+        )
         if config.disable_kafka:
             log.info("INIT: not running Kafka reader/writer due to disable_kafka")
         elif config.disable_kafka_writer:
@@ -261,6 +268,7 @@ class BanjaxApp:
             self.kafka_reader = KafkaReader(
                 self.config_holder, self.dynamic_lists,
                 health=self.health.register("kafka-reader"),
+                pipeline=kafka_pipeline,
             )
             self.kafka_reader.start()
         else:
@@ -268,6 +276,7 @@ class BanjaxApp:
             self.kafka_reader = KafkaReader(
                 self.config_holder, self.dynamic_lists,
                 health=self.health.register("kafka-reader"),
+                pipeline=kafka_pipeline,
             )
             self.kafka_reader.start()
             self.kafka_writer = KafkaWriter(

@@ -228,6 +228,16 @@ class Config:
     # a wedged device trips the breaker before the next burst; 0 = off
     # (the default — standalone tests run without a probe thread)
     matcher_probe_seconds: float = 0.0
+    # two-phase fused matcher+windows under the pipeline: program A
+    # (stateless match) dispatches ahead on the submit stage, the window
+    # commit (program B) runs at drain in admission order — no dense
+    # bitmap ever crosses the host boundary. false restores the PR 2
+    # classic-bitmap split protocol.
+    pipeline_fused: bool = True
+    # route KafkaReader command messages through the pipeline's admission
+    # buffer (same bounded-block/oldest-first-shed accounting as tailer
+    # lines); only meaningful when pipeline_enabled is true
+    pipeline_kafka: bool = True
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -268,6 +278,7 @@ _SCALAR_KEYS = {
     "pipeline_enabled": bool, "pipeline_ring_size": int,
     "pipeline_latency_budget_ms": float, "pipeline_buffer_lines": int,
     "pipeline_max_block_ms": float, "matcher_probe_seconds": float,
+    "pipeline_fused": bool, "pipeline_kafka": bool,
 }
 
 _DICT_OR_LIST_KEYS = {

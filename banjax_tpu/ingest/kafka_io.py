@@ -218,7 +218,14 @@ def _handle_session_command(
 
 
 class KafkaReader:
-    """kafka.go:93-174 — reconnect loop around the transport."""
+    """kafka.go:93-174 — reconnect loop around the transport.
+
+    With `pipeline` set (the streaming pipeline scheduler), each received
+    message is admitted into the pipeline's buffer instead of dispatched
+    inline: commands then get the same bounded-block/oldest-first-shed
+    backpressure accounting as tailer lines (admitted == processed + shed
+    spans both producers) and execute on the drain thread in admission
+    order.  Without a pipeline the reference's inline dispatch is kept."""
 
     def __init__(
         self,
@@ -227,12 +234,14 @@ class KafkaReader:
         transport: Optional[KafkaTransport] = None,
         backoff: Optional[Backoff] = None,
         health: Optional[ComponentHealth] = None,
+        pipeline=None,
     ):
         self.config_holder = config_holder
         self.decision_lists = decision_lists
         self.transport = transport or default_transport()
         self.backoff = backoff or _reconnect_backoff()
         self.health = health
+        self.pipeline = pipeline
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -262,18 +271,13 @@ class KafkaReader:
                     self.backoff.reset()
                     if self.health is not None:
                         self.health.ok()
-                    try:
-                        command = json.loads(raw)
-                    except json.JSONDecodeError:
-                        log.warning("KAFKA: unmarshal failed: %r", raw[:200])
-                        continue
-                    if not isinstance(command, dict):
-                        continue
-                    if config.debug or command.get("print_log"):
-                        log.info("KAFKA: message N: %s, V: %s, S: %s, Src: %s",
-                                 command.get("Name"), command.get("Value"),
-                                 command.get("session_id"), command.get("source"))
-                    handle_command(self.config_holder.get(), command, self.decision_lists)
+                    if self.pipeline is not None:
+                        # admission-buffer path: backpressure + shed
+                        # accounting shared with the tailer; dispatched by
+                        # the drain stage in admission order
+                        self.pipeline.submit_commands([raw], self.dispatch_raw)
+                    else:
+                        self.dispatch_raw(raw)
             except Exception as e:  # noqa: BLE001 — any transport failure → reconnect
                 log.warning("KAFKA: reader failed: %s", e)
                 if self.health is not None:
@@ -282,6 +286,25 @@ class KafkaReader:
                 return
             log.info("KAFKA: reconnecting kafka reader (attempt %d)",
                      self.backoff.attempt)
+
+    def dispatch_raw(self, raw: bytes) -> None:
+        """Parse + dispatch one command message (the reference's loop
+        body).  Own method so the pipeline's drain stage can run it per
+        admitted message; a malformed message loses itself, never the
+        stream."""
+        config = self.config_holder.get()
+        try:
+            command = json.loads(raw)
+        except json.JSONDecodeError:
+            log.warning("KAFKA: unmarshal failed: %r", raw[:200])
+            return
+        if not isinstance(command, dict):
+            return
+        if config.debug or command.get("print_log"):
+            log.info("KAFKA: message N: %s, V: %s, S: %s, Src: %s",
+                     command.get("Name"), command.get("Value"),
+                     command.get("session_id"), command.get("source"))
+        handle_command(config, command, self.decision_lists)
 
 
 class KafkaWriter:

@@ -15,12 +15,14 @@ pinned reader at LastOffset, :353-406 report writer):
     Kafka 4.x brokers require after KIP-896 removed the pre-2.1 versions.
   * Metadata for leader discovery over the bootstrap broker list.
   * ListOffsets(latest) for the reference's LastOffset start position.
-  * Fetch long-polling with min_bytes/max_wait from config; gzip- and
-    snappy-compressed batches are decompressed (snappy raw blocks per the
-    record-batch v2 spec, plus the xerial framing old producers wrap
-    message-sets in — decoded in pure stdlib, VERDICT C17); lz4/zstd
-    batches are logged once per codec, counted (skipped_batch_count →
-    the metrics line's KafkaSkippedBatches), and skipped.
+  * Fetch long-polling with min_bytes/max_wait from config; gzip-, snappy-
+    and lz4-compressed batches are decompressed in pure stdlib (snappy raw
+    blocks per the record-batch v2 spec plus the xerial framing old
+    producers wrap message-sets in — VERDICT C17; lz4 frame format with
+    the block sequence decoder below, header checksums skipped so the
+    broken legacy v0/v1 framing decodes too); zstd batches are logged once
+    per codec, counted (skipped_batch_count → the metrics line's
+    KafkaSkippedBatches), and skipped.
   * Produce acks=1 round-robining the report topic's partitions (the
     reference writer's default balancer behavior).
 
@@ -210,6 +212,184 @@ def snappy_compress(data: bytes) -> bytes:
             out += ln.to_bytes(2, "little")
         out += chunk
         pos += len(chunk)
+    return bytes(out)
+
+
+# ------------------------------------------------------------ lz4 (codec 3)
+
+_LZ4_MAGIC = 0x184D2204
+
+
+def xxh32(data: bytes, seed: int = 0) -> int:
+    """xxHash32 (the lz4 frame checksum function) — needed only to WRITE
+    valid frame headers (lz4_compress); reads skip checksum verification."""
+    P1, P2, P3, P4, P5 = (
+        2654435761, 2246822519, 3266489917, 668265263, 374761393,
+    )
+    M = 0xFFFFFFFF
+
+    def rotl(x: int, r: int) -> int:
+        return ((x << r) | (x >> (32 - r))) & M
+
+    n = len(data)
+    i = 0
+    if n >= 16:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed & M
+        v4 = (seed - P1) & M
+        while i + 16 <= n:
+            for k, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 4 * k : i + 4 * k + 4], "little")
+                v = (v + lane * P2) & M
+                v = (rotl(v, 13) * P1) & M
+                if k == 0:
+                    v1 = v
+                elif k == 1:
+                    v2 = v
+                elif k == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 16
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while i + 4 <= n:
+        h = (h + int.from_bytes(data[i : i + 4], "little") * P3) & M
+        h = (rotl(h, 17) * P4) & M
+        i += 4
+    while i < n:
+        h = (h + data[i] * P5) & M
+        h = (rotl(h, 11) * P1) & M
+        i += 1
+    h ^= h >> 15
+    h = (h * P2) & M
+    h ^= h >> 13
+    h = (h * P3) & M
+    h ^= h >> 16
+    return h
+
+
+def lz4_decompress(data: bytes) -> bytes:
+    """Pure-stdlib lz4 FRAME decode (what Kafka codec 3 carries in both
+    the record-batch v2 payload and the legacy message-set wrapper).
+    Checksums (header/block/content) are parsed past but not verified —
+    deliberately: the pre-KIP-57 Java clients computed the header checksum
+    over the wrong span, and verifying would reject their batches."""
+    if len(data) < 7 or int.from_bytes(data[:4], "little") != _LZ4_MAGIC:
+        raise KafkaWireError("lz4: bad frame magic")
+    flg = data[4]
+    if flg >> 6 != 1:
+        raise KafkaWireError(f"lz4: unsupported frame version {flg >> 6}")
+    block_checksum = (flg >> 4) & 1
+    content_size = (flg >> 3) & 1
+    dict_id = flg & 1
+    pos = 6  # magic + FLG + BD
+    if content_size:
+        pos += 8
+    if dict_id:
+        pos += 4
+    pos += 1  # HC byte (not verified, see docstring)
+    out = bytearray()
+    while True:
+        if pos + 4 > len(data):
+            raise KafkaWireError("lz4: truncated block header")
+        word = int.from_bytes(data[pos : pos + 4], "little")
+        pos += 4
+        if word == 0:  # EndMark
+            break
+        size = word & 0x7FFFFFFF
+        if pos + size > len(data):
+            raise KafkaWireError("lz4: truncated block")
+        blk = data[pos : pos + size]
+        pos += size
+        if block_checksum:
+            pos += 4
+        if word & 0x80000000:  # stored uncompressed
+            out += blk
+        else:
+            out += _lz4_decode_block(blk)
+    return bytes(out)
+
+
+def _lz4_decode_block(data: bytes) -> bytes:
+    """One lz4 compressed block: a sequence stream of (token, literals,
+    offset, match) with possibly-overlapping back-copies; the last
+    sequence is literals-only."""
+    out = bytearray()
+    pos = 0
+    n = len(data)
+    while pos < n:
+        token = data[pos]
+        pos += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                if pos >= n:
+                    raise KafkaWireError("lz4: truncated literal length")
+                b = data[pos]
+                pos += 1
+                lit += b
+                if b != 255:
+                    break
+        if pos + lit > n:
+            raise KafkaWireError("lz4: truncated literals")
+        out += data[pos : pos + lit]
+        pos += lit
+        if pos == n:
+            break  # last sequence carries no match
+        if pos + 2 > n:
+            raise KafkaWireError("lz4: truncated match offset")
+        off = int.from_bytes(data[pos : pos + 2], "little")
+        pos += 2
+        if off == 0 or off > len(out):
+            raise KafkaWireError("lz4: match offset out of range")
+        mlen = token & 0xF
+        if mlen == 15:
+            while True:
+                if pos >= n:
+                    raise KafkaWireError("lz4: truncated match length")
+                b = data[pos]
+                pos += 1
+                mlen += b
+                if b != 255:
+                    break
+        mlen += 4
+        while mlen > 0:  # overlapping copies replicate the trailing bytes
+            take = min(mlen, off)
+            start = len(out) - off
+            out += out[start : start + take]
+            mlen -= take
+    return bytes(out)
+
+
+def lz4_compress(data: bytes) -> bytes:
+    """Literal-only lz4 frame encoder (valid lz4, no back-references) —
+    the fixture/producer counterpart of lz4_decompress, mirroring
+    snappy_compress. Header checksum is the real xxh32 so strict decoders
+    accept the frames too."""
+    flg = 0x60  # version 01, block-independent, no checksums/size/dict
+    bd = 0x70   # 4 MB max block size
+    hc = (xxh32(bytes([flg, bd])) >> 8) & 0xFF
+    out = bytearray(struct.pack("<I", _LZ4_MAGIC)) + bytes([flg, bd, hc])
+    for pos in range(0, max(1, len(data)), 65536):
+        chunk = data[pos : pos + 65536]
+        lit = len(chunk)
+        blk = bytearray()
+        if lit < 15:
+            blk.append(lit << 4)
+        else:
+            blk.append(0xF0)
+            rem = lit - 15
+            while rem >= 255:
+                blk.append(255)
+                rem -= 255
+            blk.append(rem)
+        blk += chunk
+        out += struct.pack("<I", len(blk)) + blk
+    out += struct.pack("<I", 0)  # EndMark
     return bytes(out)
 
 
@@ -570,6 +750,13 @@ def _decode_message_set(data: bytes) -> List[Tuple[int, bytes]]:
                 _skip_batch(codec, f"undecodable snappy message set ({e});")
                 continue
             out.extend(inner)
+        elif codec == 3 and value is not None:
+            try:
+                inner = _decode_message_set(lz4_decompress(value))
+            except KafkaWireError as e:
+                _skip_batch(codec, f"undecodable lz4 message set ({e});")
+                continue
+            out.extend(inner)
         elif value is not None:
             _skip_batch(codec)
     return out
@@ -618,7 +805,13 @@ def _decode_record_batches(data: bytes) -> List[Tuple[int, bytes]]:
                 # fetch loop (the same offset would refetch it forever)
                 _skip_batch(codec, f"undecodable snappy record batch ({e});")
                 continue
-        elif codec:
+        elif codec == 3:
+            try:
+                payload = lz4_decompress(payload)
+            except KafkaWireError as e:
+                _skip_batch(codec, f"undecodable lz4 record batch ({e});")
+                continue
+        elif codec:  # zstd (4) stays skip-counted
             _skip_batch(codec)
             continue
         pr = _Reader(payload)

@@ -86,6 +86,11 @@ class _Pend:
     # decoded at resolve (from the A pull)
     matched_pairs: Optional[np.ndarray] = None
     always_bits: Optional[np.ndarray] = None
+    # transfer accounting (obs/stats.py note_xfer): what this chunk moved
+    # across the host boundary — the fusion-win witness is the ABSENCE of
+    # the dense [B, n_rules] bitmap from h2d_bytes
+    h2d_bytes: int = 0
+    d2h_bytes: int = 0
 
 
 @dataclasses.dataclass
@@ -145,10 +150,10 @@ class FusedWindowsPipeline:
         self._next_seq = 0      # assigned at submit
         self._resolve_seq = 0   # B-dispatch order
         self._collect_seq = 0   # shadow-write order
-        # collect turns of chunks that died in resolve: swept lazily when
-        # the collect counter reaches them (advancing out of turn would
-        # steal an earlier resolved-but-uncollected chunk's turn)
-        self._dead_collect: set = set()
+        # turns of chunks that died before taking them (resolve failure,
+        # abandon): swept lazily when the counter reaches them — advancing
+        # out of turn would steal an earlier live chunk's turn
+        self._dead = {"_resolve_seq": set(), "_collect_seq": set()}
 
     # ---- program A: stateless match + flags ----
 
@@ -239,7 +244,12 @@ class FusedWindowsPipeline:
         shifts = jnp.asarray(_SHIFTS, dtype=jnp.int32)
 
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def apply(state, bits, slots, ts_s, ts_ns, host_idx):
+        def apply(state, bits, slots, ts_s, ts_ns, host_idx, live):
+            # `live` gates rows that aged past the staleness cutoff while
+            # queued in the streaming pipeline: the deferred commit drops
+            # them HERE (a handful of bytes h2d) instead of re-uploading a
+            # row-filtered dense bitmap
+            bits = bits * live[:, None]
             new_state, ev = W._apply_core(
                 state, bits, active_table, host_idx, slots, ts_s, ts_ns,
                 limits, iv_s, iv_ns,
@@ -306,6 +316,10 @@ class FusedWindowsPipeline:
             ts_s=pad(ts_s).astype(np.int32),
             ts_ns=pad(ts_ns).astype(np.int32),
             host_idx=host_idx_p, B=B, Bp=Bp, K=K, P=P,
+            # the whole host→device traffic for this chunk: the encoded
+            # class array + the per-row window metadata — crucially NOT a
+            # dense [B, n_rules] bitmap
+            h2d_bytes=combined.nbytes + 4 * 3 * Bp,
         )
 
     def _wait_turn(self, p: _Pend, attr: str) -> None:
@@ -313,36 +327,57 @@ class FusedWindowsPipeline:
             while getattr(self, attr) != p.seq:
                 self._cv.wait()
 
+    def _sweep_locked(self, attr: str, v: int) -> None:
+        dead = self._dead[attr]
+        while v in dead:
+            dead.discard(v)
+            v += 1
+        setattr(self, attr, v)
+        self._cv.notify_all()
+
     def _advance(self, attr: str) -> None:
         with self._cv:
-            v = getattr(self, attr) + 1
-            if attr == "_collect_seq":
-                while v in self._dead_collect:
-                    self._dead_collect.discard(v)
-                    v += 1
-            setattr(self, attr, v)
-            self._cv.notify_all()
+            self._sweep_locked(attr, getattr(self, attr) + 1)
 
-    def _mark_collect_dead(self, seq: int) -> None:
+    def _mark_dead(self, attr: str, seq: int) -> None:
+        """Free one order turn without requiring it to be current: dead
+        turns are swept the moment the counter reaches them."""
         with self._cv:
-            self._dead_collect.add(seq)
-            while self._collect_seq in self._dead_collect:
-                self._dead_collect.discard(self._collect_seq)
-                self._collect_seq += 1
-            self._cv.notify_all()
+            self._dead[attr].add(seq)
+            self._sweep_locked(attr, getattr(self, attr))
 
-    def resolve(self, p: _Pend) -> None:
+    def abandon(self, p: _Pend) -> None:
+        """Settle a chunk whose apply will never run (pipeline teardown,
+        a failed submit burst, or a fully-stale chunk at drain): release
+        its pins and both order turns. Safe for any not-yet-applied state —
+        program A is stateless, so an abandoned chunk leaves no trace."""
+        if p.state in ("done", "failed", "resolved"):
+            return
+        p.state = "failed"
+        self.windows.release_pins(p.slots)
+        self._mark_dead("_resolve_seq", p.seq)
+        self._mark_dead("_collect_seq", p.seq)
+
+    def idle(self) -> bool:
+        """True when no submitted chunk is awaiting its apply/collect."""
+        with self._cv:
+            return self._next_seq == self._collect_seq
+
+    def resolve(self, p: _Pend, live: Optional[np.ndarray] = None) -> None:
         """Order-gated: decode chunk p's A-flags; when ok, dispatch program
         B (the window apply) — B dispatches therefore happen strictly in
-        chunk order. Raises PipelineOverflow when the chunk must take the
-        classic fallback; the resolve turn is NOT advanced until the caller
-        completes the fallback (fallback_done), keeping later chunks'
-        applies behind this chunk's."""
+        chunk order. `live` (bool [B], default all-true) gates rows out of
+        the window commit — the streaming pipeline's drain-time staleness
+        drop composed with the deferred apply. Raises PipelineOverflow when
+        the chunk must take the classic fallback; the resolve turn is NOT
+        advanced until the caller completes the fallback (fallback_done),
+        keeping later chunks' applies behind this chunk's."""
         self._wait_turn(p, "_resolve_seq")
         if p.state != "submitted":
             return
         try:
             buf = np.asarray(p.sparse_buf)
+            p.d2h_bytes += buf.nbytes
             P = p.P
             R8 = self.pf._nf8 * 8
             flags = np.frombuffer(buf[:16].tobytes(), dtype="<i4")
@@ -358,9 +393,9 @@ class FusedWindowsPipeline:
             )
             n_pairs = int(flags[2])
             if n_pairs <= P:
-                live = pairs[:n_pairs]
-                rows_idx = live // R8
-                cols = live - rows_idx * R8
+                live_pairs = pairs[:n_pairs]
+                rows_idx = live_pairs // R8
+                cols = live_pairs - rows_idx * R8
                 # same invariant as prefilter.collect: row in range AND
                 # col within the true rule count, so matched_pairs is a
                 # clean invariant at the source (consumers may index f_idx
@@ -369,7 +404,7 @@ class FusedWindowsPipeline:
                     (rows_idx >= 0) & (rows_idx < p.B)
                     & (cols < self.pf.plan.stage2.n_rules)
                 )
-                p.matched_pairs = live[keep]
+                p.matched_pairs = live_pairs[keep]
             if not flags[0]:
                 p.state = "overflow"
                 self.fallback_batches += 1
@@ -384,12 +419,16 @@ class FusedWindowsPipeline:
                 slots_p = np.concatenate(
                     [slots_p, np.zeros(p.Bp - p.B, dtype=np.int32)]
                 )
+            live_p = np.ones(p.Bp, dtype=np.uint8)
+            if live is not None:
+                live_p[: p.B] = np.asarray(live, dtype=np.uint8)
+            p.h2d_bytes += live_p.nbytes
             with wnd._lock:
                 wnd._run_maintenance_locked()
                 new_state, ebuf = apply(
                     wnd._state, p.bits_dev, jnp.asarray(slots_p),
                     jnp.asarray(p.ts_s), jnp.asarray(p.ts_ns),
-                    jnp.asarray(p.host_idx),
+                    jnp.asarray(p.host_idx), jnp.asarray(live_p),
                 )
                 wnd._state = new_state
             try:
@@ -404,13 +443,13 @@ class FusedWindowsPipeline:
         except Exception:
             # the chunk is dead: free its order turns (a stuck turn would
             # deadlock every later resolve/collect forever) and the pins.
-            # The resolve turn is held by this call and advances directly;
-            # the collect turn may still belong to an EARLIER uncollected
-            # chunk, so it is marked dead and swept lazily in order.
+            # The resolve turn is held by this call (current == p.seq) so
+            # _mark_dead advances it directly; the collect turn may still
+            # belong to an EARLIER uncollected chunk and sweeps lazily.
             p.state = "failed"
             self.windows.release_pins(p.slots)
-            self._advance("_resolve_seq")
-            self._mark_collect_dead(p.seq)
+            self._mark_dead("_resolve_seq", p.seq)
+            self._mark_dead("_collect_seq", p.seq)
             raise
         self._advance("_resolve_seq")
 
@@ -434,6 +473,7 @@ class FusedWindowsPipeline:
         wnd = self.windows
         try:
             buf = np.asarray(p.events_buf)
+            p.d2h_bytes += buf.nbytes
             me = wnd.max_events
             off = 0
 

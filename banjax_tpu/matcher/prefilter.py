@@ -380,6 +380,8 @@ class _Pending:
     K: int               # candidate capacity
     P: int               # (row, rule) pair output capacity
     lens: np.ndarray     # caller-order lens (for empty_only always-rules)
+    h2d_bytes: int = 0   # transfer accounting (obs/stats.py note_xfer)
+    d2h_bytes: int = 0
 
 
 class FusedPrefilter:
@@ -749,7 +751,9 @@ class FusedPrefilter:
             buf.copy_to_host_async()
         except AttributeError:  # interpret/CPU arrays may lack the method
             pass
-        return _Pending(buf=buf, B=B, K=K, P=P, lens=lens)
+        return _Pending(
+            buf=buf, B=B, K=K, P=P, lens=lens, h2d_bytes=combined.nbytes
+        )
 
     def collect(self, p: _Pending) -> np.ndarray:
         """Block on a submit()ed batch → [B, n_rules] uint8 bits in caller
@@ -757,6 +761,7 @@ class FusedPrefilter:
         was exceeded (the caller reruns the batch single-stage)."""
         plan = self.plan
         buf = np.asarray(p.buf)
+        p.d2h_bytes += buf.nbytes
         K, P, B = p.K, p.P, p.B
         R8 = self._nf8 * 8
         head = np.frombuffer(buf[:8].tobytes(), dtype="<i4")

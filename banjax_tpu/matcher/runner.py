@@ -92,6 +92,14 @@ class TpuMatcher(Matcher):
         # breaker-tuning item; obs/stats.py suggested_latency_budget_s)
         self._latency_budget_source = None
         self.fallback_batches = 0  # batches served by the CPU fallback
+        # two-phase fused chunks committed through the streaming pipeline
+        # (match dispatched at submit, window commit at drain) and how
+        # often one fell back to the classic replay mid-pipeline
+        self.pipelined_fused_chunks = 0
+        self.pipelined_fused_fallbacks = 0
+        # pipeline_fused=false restores the PR 2 behavior: the split
+        # protocol always takes the classic bitmap path
+        self._pipeline_fused = bool(getattr(config, "pipeline_fused", True))
         self._cpu_fallback = None
         self._health_registry = health
         self._health = health.register("matcher") if health is not None else None
@@ -365,7 +373,8 @@ class TpuMatcher(Matcher):
         return self.consume_lines([line_text], now_unix)[0]
 
     def consume_lines(
-        self, lines: Sequence[str], now_unix: Optional[float] = None
+        self, lines: Sequence[str], now_unix: Optional[float] = None,
+        _fused_ok: bool = True,
     ) -> List[ConsumeLineResult]:
         """Breaker-guarded batch entry point.
 
@@ -382,7 +391,9 @@ class TpuMatcher(Matcher):
             if not self.breaker.allow():
                 return self._fallback_consume(lines, now_unix)
             try:
-                results = self._consume_lines_inner(lines, now_unix)
+                results = self._consume_lines_inner(
+                    lines, now_unix, fused_ok=_fused_ok
+                )
             except Exception:  # noqa: BLE001 — device failure → breaker + fallback
                 log.exception(
                     "device matcher batch failed; re-running batch on the "
@@ -399,6 +410,18 @@ class TpuMatcher(Matcher):
             return results
         finally:
             self.stats.record_batch(len(lines), time.perf_counter() - t0)
+
+    def consume_lines_serial(
+        self, lines: Sequence[str], now_unix: Optional[float] = None
+    ) -> List[ConsumeLineResult]:
+        """consume_lines with the fused single-dispatch path disabled —
+        the streaming scheduler's generic drain uses this: a generic batch
+        drains on the drain thread while LATER batches' two-phase chunks
+        already hold fused-pipeline order turns, so an inline fused burst
+        here would wait on turns that only release after this very drain
+        completes (deadlock).  The classic bitmap path it takes instead is
+        differentially proven byte-identical."""
+        return self.consume_lines(lines, now_unix, _fused_ok=False)
 
     def effective_latency_budget_s(self) -> float:
         """The breaker's per-batch latency budget: the configured
@@ -508,7 +531,8 @@ class TpuMatcher(Matcher):
         return work, pre_encoded
 
     def _consume_lines_inner(
-        self, lines: Sequence[str], now_unix: Optional[float] = None
+        self, lines: Sequence[str], now_unix: Optional[float] = None,
+        fused_ok: bool = True,
     ) -> List[ConsumeLineResult]:
         now = time.time() if now_unix is None else now_unix
         results = LazyResults(len(lines))
@@ -522,7 +546,11 @@ class TpuMatcher(Matcher):
         #     dispatch (matcher/fused_windows.py) — no dense bitmap ever
         #     crosses the host boundary. Eligible when every rule is
         #     device-decidable and no line in the batch needs host eval.
-        if self.device_windows is not None and self._fw_pipeline is not None:
+        if (
+            fused_ok
+            and self.device_windows is not None
+            and self._fw_pipeline is not None
+        ):
             if pre_encoded is not None:
                 cls_ids, lens, host_eval = pre_encoded
             else:
@@ -580,12 +608,24 @@ class TpuMatcher(Matcher):
     # run the pieces on different stage threads: begin (host parse/gate/
     # encode) → submit (device dispatch, no host sync) → collect (force
     # device→host) → finish (window updates + Banner replay, which the
-    # scheduler serializes in admission order).  The fused matcher+windows
-    # single-dispatch path is bypassed here — it fuses the window apply
-    # into the device program, which cannot be deferred to the drain
-    # stage; the classic bitmap path it is differentially tested against
-    # is used instead.  Device windows themselves still work: apply_bitmap
-    # runs at finish, in admission order.
+    # scheduler serializes in admission order).
+    #
+    # Two device protocols ride the same four calls:
+    #
+    #   * classic bitmap — _match_bits_submit/collect, dense [B, n_rules]
+    #     pulled to host, window apply (device or host) entirely at finish.
+    #   * fused two-phase (matcher/fused_windows.py) — when the fused
+    #     matcher+windows pipeline is active and the batch has no
+    #     host-eval rows, submit dispatches program A (stateless match +
+    #     overflow flags) per chunk, any number of batches ahead; the
+    #     window commit (program B, state-donated segmented scan) is
+    #     DEFERRED to finish, where the drain thread dispatches it
+    #     strictly in admission order once each chunk's A-flags resolve.
+    #     The dense bitmap never crosses the host boundary — the ~16 MB
+    #     per-65k-batch re-upload the classic path pays is gone — and
+    #     drain-time staleness composes with the deferred commit as a
+    #     tiny per-row live mask.  Overflowing chunks replay classically
+    #     mid-pipeline (order turns held until the fallback applies).
 
     def pipeline_begin(self, lines: Sequence[str], now: float) -> dict:
         """Encode stage: parse + gate + byte-class encode.  Fresh (non-
@@ -594,20 +634,100 @@ class TpuMatcher(Matcher):
         work, pre_encoded = self._gate(
             lines, now, results, use_scratch=False
         )
-        return {
+        state = {
             "lines": lines, "results": results, "work": work,
             "pre": pre_encoded, "pend": None, "bits": None,
+            "fused": None,  # list of in-flight two-phase chunk entries
         }
+        if (
+            self._pipeline_fused
+            and self._fw_pipeline is not None
+            and len(work)
+        ):
+            if pre_encoded is None:
+                pre_encoded = encode_for_match(
+                    self.compiled, [p.rest for _, p in work], self._max_len
+                )
+                state["pre"] = pre_encoded
+            if not pre_encoded[2].any():  # no host-eval rows in the batch
+                state["fused_eligible"] = True
+        return state
 
     def pipeline_submit(self, state: dict) -> None:
-        if len(state["work"]):
-            state["pend"] = self._match_bits_submit(
-                state["work"], state["pre"]
-            )
+        if not len(state["work"]):
+            return
+        if state.get("fused_eligible"):
+            if self._submit_fused_pipeline(state):
+                return
+        state["pend"] = self._match_bits_submit(state["work"], state["pre"])
+
+    def _submit_fused_pipeline(self, state: dict) -> bool:
+        """Dispatch program A for every chunk of the batch (two-phase
+        path).  Returns False — with every partial entry abandoned — when
+        slot allocation refuses, so the caller falls back to the classic
+        bitmap protocol for this batch.  Any other failure abandons the
+        entries and re-raises (the scheduler then drains the batch
+        generically; program A is stateless, so nothing double-applies)."""
+        failpoints.check("matcher.device")
+        work = state["work"]
+        cls_ids, lens, _ = state["pre"]
+        entries = []
+        try:
+            for s in range(0, len(work), self._max_batch):
+                e = self._submit_pipeline_chunk(
+                    work[s : s + self._max_batch],
+                    cls_ids[s : s + self._max_batch],
+                    lens[s : s + self._max_batch],
+                )
+                if e is None:
+                    # more distinct IPs than free+unpinned slots (in-flight
+                    # batches hold pins until their drains): classic path
+                    for prev in entries:
+                        self._fw_pipeline.abandon(prev["pend"])
+                    return False
+                e["row0"] = s
+                entries.append(e)
+        except Exception:
+            for prev in entries:
+                self._fw_pipeline.abandon(prev["pend"])
+            raise
+        state["fused"] = entries
+        return True
 
     def pipeline_collect(self, state: dict) -> None:
+        if state.get("fused") is not None:
+            # wait for every chunk's A-program (compute only — the sparse
+            # pull is async and lands before resolve needs it); on failure
+            # free the chunks' order turns and pins so the generic-drain
+            # rerun cannot deadlock later two-phase batches
+            try:
+                for e in state["fused"]:
+                    buf = e["pend"].sparse_buf
+                    try:
+                        buf.block_until_ready()
+                    except AttributeError:
+                        np.asarray(buf)
+            except Exception:
+                for e in state["fused"]:
+                    self._fw_pipeline.abandon(e["pend"])
+                state["fused"] = None
+                raise
+            return
         if state["pend"] is not None:
             state["bits"] = self._match_bits_collect(state["pend"])
+
+    def pipeline_abort(self, state: dict) -> None:
+        """Settle a batch the drain stage will never finish (drain-stage
+        failure): free the two-phase chunks' order turns and slot pins so
+        later batches' resolves can't deadlock.  Idempotent."""
+        entries = state.get("fused")
+        state["fused"] = None
+        if entries:
+            for e in entries:
+                try:
+                    self._fw_pipeline.abandon(e["pend"])
+                except Exception:  # noqa: BLE001 — abort must settle every entry
+                    log.exception("fused pipeline abandon failed")
 
     def pipeline_finish(self, state: dict, now: float):
         """Drain stage: staleness re-check at EFFECTOR DRAIN time (the
@@ -631,6 +751,11 @@ class TpuMatcher(Matcher):
                     r = results[i]
                     r.old_line = True
                     r.rule_results = []
+            if state.get("fused") is not None:
+                self._finish_fused_pipeline(state, stale, results)
+                self._note_health()
+                return results, n_stale
+            if stale.any():
                 keep = np.flatnonzero(~stale)
                 work = work.take(keep)
                 bits = bits[keep]
@@ -646,6 +771,76 @@ class TpuMatcher(Matcher):
             self.stats.record_batch(
                 len(state["lines"]), time.perf_counter() - t0
             )
+
+    def _finish_fused_pipeline(self, state, stale, results) -> None:
+        """Ordered window commit for the two-phase chunks: resolve each
+        chunk (dispatching program B with the stale rows masked out),
+        collect its events, replay.  Overflow falls back to the classic
+        replay mid-pipeline; a failed chunk loses only its own lines —
+        its order turns and pins are freed either way, so later chunks
+        (and later batches) keep draining."""
+        entries = state["fused"]
+        state["fused"] = None
+        fw = self._fw_pipeline
+        from banjax_tpu.matcher.fused_windows import PipelineOverflow
+
+        for e in entries:
+            pend = e["pend"]
+            s = e["row0"]
+            n = len(e["work"])
+            chunk_stale = stale[s : s + n]
+            live = None
+            if chunk_stale.any():
+                if chunk_stale.all():
+                    # nothing to commit: freeing the turns without a B
+                    # dispatch matches the classic path's row removal
+                    fw.abandon(pend)
+                    continue
+                live = ~chunk_stale
+            try:
+                fw.resolve(pend, live=live)
+            except PipelineOverflow as ov:
+                self.pipelined_fused_fallbacks += 1
+                try:
+                    self._pipeline_fallback_entry(e, ov, results, live=live)
+                except Exception:  # noqa: BLE001 — one chunk's loss, not the stream's
+                    log.exception(
+                        "pipelined fused overflow fallback failed; chunk "
+                        "lines marked error"
+                    )
+                    self._mark_chunk_error(e, chunk_stale, results)
+                    self.note_device_outcome(0.0, ok=False)
+                self.stats.note_xfer(pend.h2d_bytes, pend.d2h_bytes)
+                continue
+            except Exception:  # noqa: BLE001 — resolve freed the turns/pins already
+                log.exception(
+                    "pipelined fused window commit failed; chunk lines "
+                    "marked error"
+                )
+                self._mark_chunk_error(e, chunk_stale, results)
+                self.note_device_outcome(0.0, ok=False)
+                continue
+            try:
+                res = fw.collect(pend)
+                self._replay_window_events(
+                    e["work"], None, (res.matched_pairs, res.always_bits),
+                    res.events, results, live_rows=live,
+                )
+                self.pipelined_fused_chunks += 1
+            except Exception:  # noqa: BLE001 — collect released pins/turns in finally
+                log.exception(
+                    "pipelined fused event collect failed; chunk lines "
+                    "marked error"
+                )
+                self._mark_chunk_error(e, chunk_stale, results)
+                self.note_device_outcome(0.0, ok=False)
+            finally:
+                self.stats.note_xfer(pend.h2d_bytes, pend.d2h_bytes)
+
+    def _mark_chunk_error(self, e, chunk_stale, results) -> None:
+        for k in np.flatnonzero(~chunk_stale):
+            i, _ = e["work"][int(k)]
+            results[i].error = True
 
     def probe(self, now_unix: Optional[float] = None) -> bool:
         """Synthetic device probe (ROADMAP matcher-staleness item): one
@@ -1041,9 +1236,12 @@ class TpuMatcher(Matcher):
 
         self._with_window_slots(work, *make(cls_ids, lens), results)
 
-    def _pipeline_fallback_entry(self, e, ov, results) -> None:
+    def _pipeline_fallback_entry(self, e, ov, results, live=None) -> None:
         """Classic replay of one overflowing chunk (shared by the sync and
-        overlapped paths; caller guarantees all earlier chunks applied)."""
+        overlapped paths; caller guarantees all earlier chunks applied).
+        `live` (bool [n] or None) masks drain-stale rows out of both the
+        window apply and the replay — the streaming pipeline's staleness
+        drop carried through the fallback."""
         dw = self.device_windows
         pend = e["pend"]
         n = len(e["work"])
@@ -1054,6 +1252,8 @@ class TpuMatcher(Matcher):
                     n, e["cls"], e["lens"], np.zeros(n, dtype=bool),
                     np.arange(n),
                 )
+                if live is not None:
+                    bits = bits * live[:, None].astype(np.uint8)
                 apply_bits = bits
             else:
                 # bitmap is complete: keep it DEVICE-resident for the
@@ -1061,6 +1261,10 @@ class TpuMatcher(Matcher):
                 # exists to avoid); replay uses the sparse rows decoded at
                 # resolve when they fit, else one pull
                 apply_bits = pend.bits_dev[:n]
+                if live is not None:
+                    apply_bits = apply_bits * jnp.asarray(
+                        live.astype(np.uint8)
+                    )[:, None]
                 bits = None
         except Exception:
             dw.release_pins(e["slots"])
@@ -1075,10 +1279,14 @@ class TpuMatcher(Matcher):
             self._fw_pipeline.fallback_done(pend)
         if bits is None and pend.matched_pairs is not None:
             sparse = (pend.matched_pairs, pend.always_bits)
-            self._replay_window_events(e["work"], None, sparse, events, results)
+            self._replay_window_events(
+                e["work"], None, sparse, events, results, live_rows=live
+            )
             return
         if bits is None:
             bits = np.asarray(pend.bits_dev)[:n]
+            if live is not None:
+                bits = bits * live[:, None].astype(np.uint8)
         self._replay_window_events(e["work"], bits, None, events, results)
 
     def _sparse_row_sets(self, n, sparse):
@@ -1104,11 +1312,14 @@ class TpuMatcher(Matcher):
         return row_ids
 
     def _replay_window_events(
-        self, work, bits, sparse, events, results
+        self, work, bits, sparse, events, results, live_rows=None
     ) -> None:
         """Replay window events + match bookkeeping into ConsumeLineResults
         (per-site-then-global rule order, Banner per exceeded event) —
-        shared by the classic bitmap path and the fused pipeline."""
+        shared by the classic bitmap path and the fused pipeline.
+        `live_rows` (bool [n]) skips rows the drain-time staleness check
+        dropped: their bits were masked out of the window apply, so no
+        event exists for them and no effect may fire."""
         evmap = {(e.line, e.rule_id): e for e in events}
         if sparse is not None:
             row_ids = self._sparse_row_sets(len(work), sparse)
@@ -1116,6 +1327,8 @@ class TpuMatcher(Matcher):
         else:
             row_any = bits.any(axis=1)
             row_iter = (r for r in range(len(work)) if row_any[r])
+        if live_rows is not None:
+            row_iter = (r for r in row_iter if live_rows[r])
         for row in row_iter:
             i, p = work[row]
             # per-site-then-global ORDER via a position dict over the few
@@ -1162,6 +1375,10 @@ class TpuMatcher(Matcher):
 
         def make(bits_c):
             def apply_fn(work_c, slots, ts_s, ts_ns, host_idx, results_c):
+                # the dense-bitmap re-upload the fused two-phase path
+                # exists to eliminate: count it so the win is measurable
+                if isinstance(bits_c, np.ndarray):
+                    self.stats.note_xfer(h2d_bytes=bits_c.nbytes)
                 events = self.device_windows.apply_bitmap(
                     bits_c, slots, ts_s, ts_ns, self._active_table, host_idx
                 )
@@ -1229,9 +1446,18 @@ class TpuMatcher(Matcher):
                 )
             ]
         elif self._mesh_matcher is not None:
-            # the mesh backend's match_bits is synchronous; run it in
-            # collect so submit stays cheap and non-blocking
+            # sharded submit: dispatch the mesh device step per chunk
+            # without forcing any device→host pull — collect merges the
+            # per-shard results back into line order, so the pipeline
+            # overlaps a sharded batch exactly like a single-device one
             pend["kind"] = "mesh"
+            pend["chunks"] = [
+                (rows, self._mesh_matcher.submit(cls_ids[rows], lens[rows]))
+                for rows in (
+                    device_rows[s : s + self._max_batch]
+                    for s in range(0, len(device_rows), self._max_batch)
+                )
+            ]
         else:
             pend["kind"] = "single"
             pend["chunks"] = self._single_stage_submit(
@@ -1257,6 +1483,9 @@ class TpuMatcher(Matcher):
                 bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
                 for sl, p in pend["chunks"]:
                     bits[sl] = self._prefilter.collect(p)
+                    self.stats.note_xfer(
+                        getattr(p, "h2d_bytes", 0), getattr(p, "d2h_bytes", 0)
+                    )
                 # a zero-length row must contribute NO device bits (the
                 # empty_only always-rule reconstruction keys on lens == 0,
                 # which is also how host_eval rows were masked out)
@@ -1270,12 +1499,10 @@ class TpuMatcher(Matcher):
                 )
         elif pend["kind"] == "mesh":
             bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
-            # chunk by max_batch like the single-device path, so one huge
-            # tailer burst can't compile an outsized one-off program
-            for start in range(0, len(device_rows), self._max_batch):
-                rows = device_rows[start : start + self._max_batch]
-                bits[rows] = self._mesh_matcher.match_bits(
-                    cls_ids[rows], lens[rows]
+            for rows, p in pend["chunks"]:
+                bits[rows] = self._mesh_matcher.collect(p)
+                self.stats.note_xfer(
+                    p.get("h2d_bytes", 0), p.get("d2h_bytes", 0)
                 )
         else:
             bits = self._single_stage_collect(n, pend["chunks"])
@@ -1306,6 +1533,7 @@ class TpuMatcher(Matcher):
             pad_len = np.zeros(b, dtype=np.int32)
             pad_cls[: len(rows)] = cls_ids[rows]
             pad_len[: len(rows)] = lens[rows]
+            self.stats.note_xfer(h2d_bytes=pad_cls.nbytes + pad_len.nbytes)
             if self._pallas_prep is not None:
                 packed = pallas_nfa.match_batch_pallas(
                     self._pallas_prep, pad_cls, pad_len,
@@ -1321,8 +1549,10 @@ class TpuMatcher(Matcher):
     def _single_stage_collect(self, n: int, chunks: list) -> np.ndarray:
         bits = np.zeros((n, self.compiled.n_rules), dtype=np.uint8)
         for rows, packed in chunks:
+            packed_np = np.asarray(packed)
+            self.stats.note_xfer(d2h_bytes=packed_np.nbytes)
             out = np.unpackbits(
-                np.asarray(packed), axis=1, count=self.compiled.n_rules
+                packed_np, axis=1, count=self.compiled.n_rules
             )
             bits[rows] = out[: len(rows)]
         return bits

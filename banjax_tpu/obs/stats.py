@@ -17,6 +17,10 @@ _LATENCY_RING = 512  # recent batch latencies kept for the percentiles
 _DEVICE_RING = 256   # recent device-stage latencies for the pipeline p99
 
 
+def _r3(v):
+    return None if v is None else round(v, 3)
+
+
 class MatcherStats:
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -27,6 +31,13 @@ class MatcherStats:
         self._window_lines = 0
         self._window_start = time.monotonic()
         self._last_evictions = 0
+        # host<->device transfer accounting (the fusion-win witness: the
+        # pipelined fused path must show the dense-bitmap re-upload gone)
+        self.h2d_bytes_total = 0
+        self.d2h_bytes_total = 0
+        self._window_h2d = 0
+        self._window_d2h = 0
+        self._window_batches = 0
 
     def record_batch(self, n_lines: int, elapsed_s: float) -> None:
         with self._lock:
@@ -35,6 +46,23 @@ class MatcherStats:
             self._latencies[self._lat_n % _LATENCY_RING] = elapsed_s
             self._lat_n += 1
             self._window_lines += n_lines
+            self._window_batches += 1
+
+    def note_xfer(self, h2d_bytes: int = 0, d2h_bytes: int = 0) -> None:
+        """Bytes a device path moved across the host boundary (encoded
+        input, dense bitmaps, sparse pulls).  Counted at the runner's choke
+        points, not at every jnp.asarray — the point is comparability
+        between the classic and fused paths, not a byte-perfect ledger."""
+        with self._lock:
+            self.h2d_bytes_total += int(h2d_bytes)
+            self.d2h_bytes_total += int(d2h_bytes)
+            self._window_h2d += int(h2d_bytes)
+            self._window_d2h += int(d2h_bytes)
+
+    def h2d_bytes_per_batch(self) -> float:
+        """Lifetime average h2d bytes per recorded batch (bench/tests)."""
+        with self._lock:
+            return self.h2d_bytes_total / max(1, self.batches_total)
 
     def snapshot(self, device_windows=None, matcher=None) -> Dict[str, object]:
         """Additive metrics-line keys; resets the lines/sec window."""
@@ -56,7 +84,21 @@ class MatcherStats:
                 "MatcherBatchLatencyP99Ms": (
                     round(lats[min(n - 1, (n * 99) // 100)] * 1e3, 3) if n else None
                 ),
+                "MatcherH2dBytesTotal": self.h2d_bytes_total,
+                "MatcherD2hBytesTotal": self.d2h_bytes_total,
+                # per-batch averages over THIS reporting interval: the
+                # operator-visible witness that fused+pipelined killed the
+                # ~16 MB/batch dense re-upload
+                "MatcherH2dBytesPerBatch": round(
+                    self._window_h2d / max(1, self._window_batches), 1
+                ),
+                "MatcherD2hBytesPerBatch": round(
+                    self._window_d2h / max(1, self._window_batches), 1
+                ),
             }
+            self._window_h2d = 0
+            self._window_d2h = 0
+            self._window_batches = 0
         if device_windows is not None:
             out["DeviceWindowsOccupancy"] = device_windows.occupancy
             out["DeviceWindowsCapacity"] = device_windows.capacity
@@ -82,12 +124,33 @@ class MatcherStats:
             if mm is not None:
                 out["MeshFusedBatches"] = mm.fused_batches
                 out["MeshFallbackBatches"] = mm.fallback_batches
+                # sharded submit/drain latency (parallel/mesh.py): dispatch
+                # wall time vs the per-shard d2h pull + line-order merge
+                out["MeshSubmitMsEwma"] = _r3(
+                    getattr(mm, "submit_ms_ewma", None)
+                )
+                out["MeshMergeMsEwma"] = _r3(
+                    getattr(mm, "merge_ms_ewma", None)
+                )
+                shard_ms = getattr(mm, "last_shard_merge_ms", None) or []
+                out["MeshShardMergeMsMax"] = _r3(
+                    max(shard_ms) if shard_ms else None
+                )
             if getattr(matcher, "_prefilter", None) is not None:
                 out["PrefilterActive"] = True
             fw = getattr(matcher, "_fw_pipeline", None)
             if fw is not None:
                 out["PipelineFusedBatches"] = fw.fused_batches
                 out["PipelineFallbackBatches"] = fw.fallback_batches
+                # two-phase (match-ahead, drain-commit) chunks driven by
+                # the streaming pipeline, and its overflow fallbacks —
+                # distinct from the sync-path counters above
+                out["PipelinedFusedChunks"] = getattr(
+                    matcher, "pipelined_fused_chunks", 0
+                )
+                out["PipelinedFusedFallbacks"] = getattr(
+                    matcher, "pipelined_fused_fallbacks", 0
+                )
             # circuit breaker (resilience/breaker.py): the one place all
             # the ad-hoc fallback counters roll up for operators —
             # nonzero MatcherCpuFallbackBatches = batches served in
@@ -108,8 +171,12 @@ class PipelineStats:
 
     The accounting invariant the fault suite asserts: after a flush,
     admitted_lines == processed_lines + shed_lines + drain_error_lines —
-    every admitted line is either processed (a result was produced for
-    it, old_line included) or counted as shed; nothing is silent.
+    every admitted item is either processed (a result was produced for
+    it, old_line included) or counted as shed; nothing is silent.  Kafka
+    command messages routed through the admission buffer count in the
+    SAME admitted/processed/shed totals (the invariant spans both
+    producers); command_items/command_batches break the command share
+    out for operators.
     """
 
     def __init__(self) -> None:
@@ -121,6 +188,8 @@ class PipelineStats:
         self.stale_dropped_lines = 0  # aged past cutoff inside the pipeline
         self.batches = 0
         self.fallback_batches = 0   # drained generically via consume_lines
+        self.command_items = 0      # kafka commands drained in admission order
+        self.command_batches = 0
         self.probe_ok = 0
         self.probe_failed = 0
         self._device_ring = [0.0] * _DEVICE_RING
@@ -152,6 +221,11 @@ class PipelineStats:
             self.batches += 1
             if fallback:
                 self.fallback_batches += 1
+
+    def note_commands(self, n: int) -> None:
+        with self._lock:
+            self.command_items += n
+            self.command_batches += 1
 
     def note_probe(self, ok: bool) -> None:
         with self._lock:
@@ -198,6 +272,8 @@ class PipelineStats:
                 "PipelineStaleDroppedLines": self.stale_dropped_lines,
                 "PipelineBatches": self.batches,
                 "PipelineFallbackBatches": self.fallback_batches,
+                "PipelineCommandItems": self.command_items,
+                "PipelineCommandBatches": self.command_batches,
                 "PipelineProbeFailures": self.probe_failed,
                 "PipelineDeviceP99Ms": (
                     None if p99 is None else round(p99 * 1e3, 3)

@@ -24,6 +24,7 @@ TpuMatcher plugs into `_match_bits` when a mesh is configured.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -459,6 +460,11 @@ class ShardedMatchBackend:
         # back to the single-stage sharded NFA (candidate overflow)
         self.fused_batches = 0
         self.fallback_batches = 0
+        # sharded submit/drain latency (metrics line): dispatch wall time,
+        # the per-shard d2h pulls of the last drain, and their EWMAs
+        self.submit_ms_ewma: Optional[float] = None
+        self.merge_ms_ewma: Optional[float] = None
+        self.last_shard_merge_ms: list = []
         if backend == "xla":
             self._prep = None
             self._params = shard_params(compiled, mesh)
@@ -493,9 +499,32 @@ class ShardedMatchBackend:
             self._fused_fns[key] = hit
         return hit
 
-    def match_bits(self, cls_ids: np.ndarray, lens: np.ndarray) -> np.ndarray:
-        """[B, L] encoded lines → [B, n_rules] uint8, any B (dp remainder
-        handled by padding; output order matches input order)."""
+    def _dispatch(self, fn, params, cls_dev, lens_dev):
+        if self.backend == "xla":
+            return fn(params, jnp.asarray(cls_dev), jnp.asarray(lens_dev))
+        cls_t = np.ascontiguousarray(cls_dev.T)
+        return fn(params, jnp.asarray(cls_t), jnp.asarray(lens_dev))
+
+    @staticmethod
+    def _async_copy(arr) -> None:
+        try:
+            arr.copy_to_host_async()
+        except AttributeError:
+            pass
+
+    def _ewma(self, attr: str, value_ms: float) -> None:
+        prev = getattr(self, attr)
+        setattr(
+            self, attr,
+            value_ms if prev is None else prev + 0.2 * (value_ms - prev),
+        )
+
+    def submit(self, cls_ids: np.ndarray, lens: np.ndarray) -> dict:
+        """Dispatch the sharded device step for one batch WITHOUT forcing
+        any device→host transfer — the streaming pipeline's submit stage.
+        Returns a pend dict for collect(); the async host copies are
+        already in flight so collect()'s pull overlaps later submits."""
+        t0 = time.perf_counter()
         cls_ids = np.asarray(cls_ids, dtype=np.int32)
         lens = np.asarray(lens, dtype=np.int32)
         B, L = cls_ids.shape
@@ -533,13 +562,17 @@ class ShardedMatchBackend:
         cls_dev = cls_sorted[perm]
         lens_dev = lens_sorted[perm]
 
-        out = None
+        pend = {
+            "B": B, "Bp": Bp, "L_p": L_p, "order": order, "perm": perm,
+            "lens_dev": lens_dev, "cls_dev": cls_dev, "fused": False,
+            "h2d_bytes": cls_dev.nbytes + lens_dev.nbytes, "d2h_bytes": 0,
+        }
+        fused = None
         if self.plan is not None:
             # fused two-stage: stage-1 gate per dp shard, stage-2 on the
             # compacted candidates only; per-shard candidate overflow
             # (adversarial all-matching traffic) falls back to the
             # single-stage sharded NFA — never under-matches
-            fused = None
             try:
                 fused = self._fused(Bp, L_p)
             except pallas_nfa.PallasUnsupported as e:
@@ -552,36 +585,49 @@ class ShardedMatchBackend:
                     "fused mesh prefilter unavailable (%s); single-stage", e
                 )
                 self.plan = None
-        if self.plan is not None and fused is not None:
+        if fused is not None:
             fn, params, K = fused
-            if self.backend == "xla":
-                bits_d, n_cand = fn(
-                    *params, jnp.asarray(cls_dev), jnp.asarray(lens_dev)
-                )
-            else:
-                cls_t = np.ascontiguousarray(cls_dev.T)
-                bits_d, n_cand = fn(
-                    *params, jnp.asarray(cls_t), jnp.asarray(lens_dev)
-                )
+            bits_d, n_cand = self._dispatch(
+                lambda p, c, ln: fn(*p, c, ln), params, cls_dev, lens_dev
+            )
+            self._async_copy(n_cand)
+            self._async_copy(bits_d)
+            pend.update(fused=True, K=K, bits_d=bits_d, n_cand=n_cand)
             if self.health is not None:
                 self.health.beat()
-            if int(np.asarray(n_cand).max()) <= K:
-                # np.array (not asarray): the jax buffer is read-only and
-                # the always-rule flags write into it below
-                out = np.array(bits_d)
+        else:
+            fn = self._fn(Bp, L_p)
+            out_d = self._dispatch(fn, self._params, cls_dev, lens_dev)
+            self._async_copy(out_d)
+            pend["out_d"] = out_d
+        self._ewma("submit_ms_ewma", (time.perf_counter() - t0) * 1e3)
+        return pend
+
+    def collect(self, pend: dict) -> np.ndarray:
+        """Force a submit()ted batch: pull each dp shard's rows, merge them
+        back into the caller's line order, apply the host-side always-rule
+        flags.  The per-shard pull latencies land in last_shard_merge_ms
+        (metrics: MeshShardMergeMsMax)."""
+        t0 = time.perf_counter()
+        B, Bp = pend["B"], pend["Bp"]
+        order, perm = pend["order"], pend["perm"]
+        out = None
+        if pend["fused"]:
+            if int(np.asarray(pend["n_cand"]).max()) <= pend["K"]:
+                out = self._pull_shards(pend["bits_d"])
                 self.fused_batches += 1
                 if self.health is not None:
                     self.health.ok()
                 # always-rule static flags (host-applied, like the
                 # single-device collect())
                 plan = self.plan
-                if plan.n_always:
+                if plan is not None and plan.n_always:
                     aw = np.asarray(plan.stage1.always_match[: plan.n_always])
                     ae = np.asarray(plan.stage1.empty_only[: plan.n_always])
                     if aw.any():
                         out[:, plan.a_idx[aw]] = 1
                     if ae.any():
-                        empty_rows = np.flatnonzero(lens_dev == 0)
+                        empty_rows = np.flatnonzero(pend["lens_dev"] == 0)
                         out[np.ix_(empty_rows, plan.a_idx[ae])] = 1
             else:
                 self.fallback_batches += 1
@@ -593,16 +639,13 @@ class ShardedMatchBackend:
                         "single-stage rerun"
                     )
         if out is None:
-            fn = self._fn(Bp, L_p)
-            if self.backend == "xla":
-                out = np.asarray(
-                    fn(self._params, jnp.asarray(cls_dev), jnp.asarray(lens_dev))
+            if "out_d" not in pend:
+                fn = self._fn(Bp, pend["L_p"])
+                pend["out_d"] = self._dispatch(
+                    fn, self._params, pend["cls_dev"], pend["lens_dev"]
                 )
-            else:
-                cls_t = np.ascontiguousarray(cls_dev.T)
-                out = np.asarray(
-                    fn(self._params, jnp.asarray(cls_t), jnp.asarray(lens_dev))
-                )
+            out = self._pull_shards(pend["out_d"])
+        pend["d2h_bytes"] += out.nbytes
 
         # undo the device permutation, then the length sort
         unperm = np.empty(Bp, dtype=np.int64)
@@ -610,4 +653,44 @@ class ShardedMatchBackend:
         out_sorted = out[unperm][:B]
         unsorted = np.empty_like(out_sorted)
         unsorted[order] = out_sorted
+        self._ewma("merge_ms_ewma", (time.perf_counter() - t0) * 1e3)
         return unsorted
+
+    def _pull_shards(self, arr) -> np.ndarray:
+        """Per-shard device→host pull into one writable host array: each dp
+        member's row block lands at its own index (rp replicas of the same
+        rows are pulled once), timed per shard."""
+        self.last_shard_merge_ms = []
+        try:
+            shards = list(arr.addressable_shards)
+        except (AttributeError, TypeError):
+            shards = []
+        if not shards:
+            t0 = time.perf_counter()
+            out = np.array(arr)
+            self.last_shard_merge_ms.append(
+                (time.perf_counter() - t0) * 1e3
+            )
+            return out
+        out = np.empty(arr.shape, dtype=arr.dtype)
+        seen = set()
+        for sh in shards:
+            idx = sh.index
+            key = tuple(
+                (sl.start, sl.stop, sl.step) if isinstance(sl, slice) else sl
+                for sl in idx
+            )
+            if key in seen:
+                continue  # an rp replica of rows already merged
+            seen.add(key)
+            t0 = time.perf_counter()
+            data = np.asarray(sh.data)
+            self.last_shard_merge_ms.append((time.perf_counter() - t0) * 1e3)
+            out[idx] = data
+        return out
+
+    def match_bits(self, cls_ids: np.ndarray, lens: np.ndarray) -> np.ndarray:
+        """[B, L] encoded lines → [B, n_rules] uint8, any B (dp remainder
+        handled by padding; output order matches input order).  The
+        synchronous convenience form of submit()/collect()."""
+        return self.collect(self.submit(cls_ids, lens))

@@ -27,6 +27,21 @@ in admission order, so per-(ip, rule) window updates and ban-log lines
 stay in log order across batch boundaries — byte-identical to the
 synchronous path (tests/differential/test_pipeline_differential.py).
 
+Fused two-phase mode: with device windows on, the split protocol drives
+the fused matcher+windows two-program path (matcher/fused_windows.py) —
+pipeline_submit dispatches program A (stateless match) ahead freely,
+and the window commit (program B) happens inside pipeline_finish on the
+drain thread, strictly in admission order.  The dense bitmap never
+crosses the host boundary (tests/differential/
+test_fused_pipeline_differential.py proves byte-identity and the h2d
+win).  Generic drains use consume_lines_serial so an inline fused burst
+can't deadlock against in-flight two-phase order turns.
+
+Kafka commands: submit_commands() admits command messages into the SAME
+buffer as tailer lines — shared bounded-block/oldest-first-shed
+accounting (admitted == processed + shed spans both producers) — and
+the drain thread dispatches each handler in admission order.
+
 Batch sizing: pipeline/sizer.py grows/shrinks the encode target within
 power-of-two buckets to hit `pipeline_latency_budget_ms` from observed
 per-stage EWMA timings, replacing the fixed `matcher_batch_lines` guess.
@@ -75,15 +90,30 @@ log = logging.getLogger(__name__)
 
 class _Batch:
     __slots__ = ("lines", "matcher", "state", "t_encode_ms", "t_device_ms",
-                 "t0_device")
+                 "t0_device", "kind")
 
-    def __init__(self, lines: List[str]):
-        self.lines = lines
+    def __init__(self, lines: List[str], kind: str = "lines"):
+        self.lines = lines      # log lines, or _Command items (kind="cmd")
         self.matcher = None
         self.state = None       # split-protocol state; None = generic drain
         self.t_encode_ms = 0.0
         self.t_device_ms = 0.0
         self.t0_device = 0.0
+        self.kind = kind
+
+
+class _Command:
+    """One Kafka command message riding the admission buffer: the raw
+    payload plus the reader's dispatch callable.  Commands share the
+    buffer bound, the bounded-block/oldest-first shed, and the
+    admitted == processed + shed accounting with tailer lines; the drain
+    stage executes them in admission order."""
+
+    __slots__ = ("raw", "handler")
+
+    def __init__(self, raw: bytes, handler: Callable[[bytes], None]):
+        self.raw = raw
+        self.handler = handler
 
 
 class PipelineScheduler:
@@ -189,7 +219,19 @@ class PipelineScheduler:
         `pipeline_max_block_ms` when the buffer is full, then sheds
         oldest-first — the tailer is never blocked unboundedly and memory
         is never unbounded."""
-        lines = list(lines)
+        self._admit(list(lines))
+
+    def submit_commands(
+        self, raws: Sequence[bytes], handler: Callable[[bytes], None]
+    ) -> None:
+        """Admit Kafka command messages into the same buffer as tailer
+        lines: identical bounded-block/oldest-first-shed accounting
+        (admitted == processed + shed holds across both producers), and
+        the drain stage dispatches `handler(raw)` per message in admission
+        order relative to everything else in the stream."""
+        self._admit([_Command(r, handler) for r in raws])
+
+    def _admit(self, lines: list) -> None:
         if not lines:
             return
         self.stats.note_admitted(len(lines))
@@ -249,16 +291,28 @@ class PipelineScheduler:
                     # wait for a fuller batch: holding the ring slot while
                     # the buffer fills starves the device stage (measured
                     # −40% on the 1-core box); partial batches are fine —
-                    # the sizer's trickle rule ignores them
+                    # the sizer's trickle rule ignores them.  A batch is
+                    # homogeneous: a run of log lines OR a run of command
+                    # messages, split at the kind boundary so admission
+                    # order is preserved exactly.
                     take = min(len(self._buf), self._sizer.target())
-                    lines = [self._buf.popleft() for _ in range(take)]
+                    lines = []
+                    is_cmd = self._buf and isinstance(self._buf[0], _Command)
+                    while (
+                        len(lines) < take and self._buf
+                        and isinstance(self._buf[0], _Command) == is_cmd
+                    ):
+                        lines.append(self._buf.popleft())
                     if lines:
                         self._inflight += 1
                     self._cond.notify_all()
                 if not lines:  # a shed emptied the buffer under us
                     self._ring.release()
                     continue
-                self._q_dev.put(self._encode_batch(lines))
+                if is_cmd:
+                    self._q_dev.put(_Batch(lines, kind="cmd"))
+                else:
+                    self._q_dev.put(self._encode_batch(lines))
         finally:
             self._q_dev.put(None)
 
@@ -313,6 +367,13 @@ class PipelineScheduler:
                     while pending:
                         self._collect(pending.popleft())
                     return
+                if batch.kind == "cmd":
+                    # no device work; FIFO still holds: everything
+                    # submitted before the commands reaches drain first
+                    while pending:
+                        self._collect(pending.popleft())
+                    self._q_drain.put(batch)
+                    continue
                 if batch.state is not None:
                     breaker = getattr(batch.matcher, "breaker", None)
                     if breaker is not None and not breaker.allow():
@@ -370,6 +431,14 @@ class PipelineScheduler:
         self._q_drain.put(batch)
 
     def _device_failure(self, batch: _Batch) -> None:
+        # settle any two-phase chunks the failed batch already dispatched
+        # (order turns + slot pins) before the generic rerun — idempotent
+        abort = getattr(batch.matcher, "pipeline_abort", None)
+        if abort is not None and batch.state is not None:
+            try:
+                abort(batch.state)
+            except Exception:  # noqa: BLE001
+                log.exception("pipeline abort after device failure failed")
         batch.state = None
         batch.t_device_ms = max(
             batch.t_device_ms, (time.perf_counter() - batch.t0_device) * 1e3
@@ -392,10 +461,29 @@ class PipelineScheduler:
             try:
                 failpoints.check("pipeline.drain")
                 now = self._now_fn()
-                if batch.state is None:
+                if batch.kind == "cmd":
+                    # command batch: dispatch each message in admission
+                    # order; a bad command loses itself, not the batch
+                    # (the handler owns parse errors, like the reference's
+                    # reader loop)
+                    for item in batch.lines:
+                        try:
+                            item.handler(item.raw)
+                        except Exception:  # noqa: BLE001
+                            log.exception("pipeline command dispatch failed")
+                    self.stats.note_commands(n)
+                elif batch.state is None:
                     # generic path: full consume_lines semantics, including
-                    # the breaker's CPU-reference fallback — never a loss
-                    results = batch.matcher.consume_lines(batch.lines, now)
+                    # the breaker's CPU-reference fallback — never a loss.
+                    # consume_lines_serial (when the matcher has it) keeps
+                    # the fused single-dispatch burst out of the drain
+                    # thread: its order turns belong to the two-phase
+                    # pipeline and an inline burst here would deadlock
+                    # behind in-flight later batches.
+                    consume = getattr(
+                        batch.matcher, "consume_lines_serial", None
+                    ) or batch.matcher.consume_lines
+                    results = consume(batch.lines, now)
                     self.stats.note_batch(fallback=True)
                 else:
                     results, n_stale = batch.matcher.pipeline_finish(
@@ -410,6 +498,16 @@ class PipelineScheduler:
                     "pipeline drain stage failed; %d lines counted as shed", n
                 )
                 self.stats.note_drain_error(n)
+                if batch.state is not None:
+                    # free any two-phase order turns/pins the unfinished
+                    # batch still holds — a leaked turn would deadlock
+                    # every later fused drain
+                    abort = getattr(batch.matcher, "pipeline_abort", None)
+                    if abort is not None:
+                        try:
+                            abort(batch.state)
+                        except Exception:  # noqa: BLE001
+                            log.exception("pipeline abort failed")
                 if self._health is not None:
                     self._health.degraded("drain failure; lines shed")
             if ok:
@@ -417,12 +515,13 @@ class PipelineScheduler:
                 if self._health is not None:
                     self._health.ok()
             t_drain_ms = (time.perf_counter() - t0) * 1e3
-            self._sizer.observe(n, {
-                "encode": batch.t_encode_ms,
-                "device": batch.t_device_ms,
-                "drain": t_drain_ms,
-            })
-            if self._on_results is not None:
+            if batch.kind != "cmd":
+                self._sizer.observe(n, {
+                    "encode": batch.t_encode_ms,
+                    "device": batch.t_device_ms,
+                    "drain": t_drain_ms,
+                })
+            if self._on_results is not None and batch.kind != "cmd":
                 try:
                     self._on_results(batch.lines, results)
                 except Exception:  # noqa: BLE001 — an observer must not stall the drain

@@ -783,6 +783,123 @@ def worker_main(backend: str, budget_s: float, only: "list | None") -> None:
 # ---------------------------------------------------------------------------
 
 STREAM_PATH = os.path.join(_DIR, "BENCH_pipeline.json")
+FUSED_STREAM_PATH = os.path.join(_DIR, "BENCH_fused_pipeline.json")
+
+
+def _fused_pipeline_mode() -> None:
+    """`bench.py --fused-pipeline`: the streaming pipeline with DEVICE
+    WINDOWS on, fused two-phase (program A at submit, window commit at
+    drain — matcher/fused_windows.py driven by pipeline/scheduler.py)
+    versus the classic bitmap split protocol (pipeline_fused: false),
+    same chunk stream.  Records both rows plus the h2d bytes/batch
+    witness into BENCH_fused_pipeline.json: the fused row must match or
+    beat the classic rate AND show the dense [B, n_rules] re-upload
+    (~16 MB per 65k batch at 1k rules) gone from the h2d counter."""
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import yaml as _yaml
+
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from banjax_tpu.pipeline import PipelineScheduler
+    from tests.mock_banner import MockBanner
+
+    backend = jax.devices()[0].platform
+    n_rules = int(os.environ.get("BENCH_STREAM_RULES", str(N_RULES)))
+    total = int(os.environ.get(
+        "BENCH_STREAM_LINES", "131072" if backend == "tpu" else "16384"
+    ))
+    feed_chunk = int(os.environ.get("BENCH_STREAM_CHUNK", "256"))
+    budget_ms = float(os.environ.get("BENCH_STREAM_BUDGET_MS", "180"))
+
+    patterns = generate_rules(n_rules)
+    rules_yaml = _yaml.safe_dump({
+        "regexes_with_rates": [
+            {"rule": f"crs{i}", "regex": p, "interval": 60,
+             "hits_per_interval": 50, "decision": "nginx_block"}
+            for i, p in enumerate(patterns)
+        ]
+    })
+    now = time.time()
+    rests = generate_lines(total, patterns, seed=47)
+    lines = [
+        f"{now:.6f} 10.7.{(i % 2048) >> 8}.{i % 256} {r}"
+        for i, r in enumerate(rests)
+    ]
+    chunks = [lines[i : i + feed_chunk] for i in range(0, total, feed_chunk)]
+
+    rows = {}
+    for label, fused in (("fused", True), ("classic", False)):
+        cfg = config_from_yaml_text(rules_yaml)
+        cfg.matcher_device_windows = True
+        cfg.pipeline_fused = fused
+        matcher = TpuMatcher(
+            cfg, MockBanner(), StaticDecisionLists(cfg),
+            RegexRateLimitStates(),
+        )
+        assert matcher._fw_pipeline is not None, (
+            "fused matcher+windows pipeline did not engage"
+        )
+        sched = PipelineScheduler(
+            lambda: matcher, latency_budget_ms=budget_ms,
+            buffer_lines=max(131072, total), now_fn=lambda: now,
+        )
+        sched.start()
+        for c in chunks:  # warm pass: compile every bucket
+            sched.submit(c)
+        assert sched.flush(600), f"{label} warm pass did not drain"
+        h2d0 = matcher.stats.h2d_bytes_total
+        batches0 = matcher.stats.batches_total
+        t0 = time.perf_counter()
+        for c in chunks:
+            sched.submit(c)
+        assert sched.flush(600), f"{label} timed pass did not drain"
+        elapsed = time.perf_counter() - t0
+        snap = sched.snapshot()
+        sched.stop()
+        n_batches = max(1, matcher.stats.batches_total - batches0)
+        rows[label] = {
+            "mode": f"pipeline+device_windows ({label})",
+            "backend": backend,
+            "value": round(total / elapsed, 1),
+            "unit": "lines/sec",
+            "vs_baseline": round(total / elapsed / TARGET, 4),
+            "elapsed_s": round(elapsed, 2),
+            "n_rules": n_rules,
+            "n_lines": total,
+            "h2d_bytes_per_batch": round(
+                (matcher.stats.h2d_bytes_total - h2d0) / n_batches, 1
+            ),
+            "pipelined_fused_chunks": matcher.pipelined_fused_chunks,
+            "pipelined_fused_fallbacks": matcher.pipelined_fused_fallbacks,
+            "pipeline_batches": snap.get("PipelineBatches"),
+            "pipeline_shed_lines": snap.get("PipelineShedLines"),
+        }
+
+    book = {
+        "metric": "log-lines/sec, streaming pipeline + device windows "
+                  "(fused two-phase vs classic bitmap)",
+        "fused": rows["fused"],
+        "classic": rows["classic"],
+        "fused_vs_classic_speedup": round(
+            rows["fused"]["value"] / max(1.0, rows["classic"]["value"]), 3
+        ),
+        # the fusion-win witness: classic re-uploads the dense bitmap
+        # (n_rules bytes/line); fused must not
+        "dense_reupload_eliminated": (
+            rows["fused"]["h2d_bytes_per_batch"]
+            < 0.5 * rows["classic"]["h2d_bytes_per_batch"]
+        ),
+    }
+    tmp = FUSED_STREAM_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(book, f, indent=1)
+    os.replace(tmp, FUSED_STREAM_PATH)
+    print(json.dumps(book))
 
 
 def _stream_mode(mode: str) -> None:
@@ -839,6 +956,11 @@ def _stream_mode(mode: str) -> None:
         ]
     })
     cfg = config_from_yaml_text(rules_yaml)
+    # BENCH_STREAM_DEVICE_WINDOWS=1: run the stream against the
+    # device-resident window counters — with --pipeline this drives the
+    # fused two-phase path (see --fused-pipeline for the full A/B)
+    device_windows = bool(os.environ.get("BENCH_STREAM_DEVICE_WINDOWS"))
+    cfg.matcher_device_windows = device_windows
     banner = MockBanner()
     matcher = TpuMatcher(
         cfg, banner, StaticDecisionLists(cfg), RegexRateLimitStates()
@@ -894,10 +1016,40 @@ def _stream_mode(mode: str) -> None:
             out[f"pipeline_stage_{k.lower()}_ewma_ms"] = snap.get(
                 f"PipelineStage{k}EwmaMs"
             )
+        if device_windows:
+            out["device_windows"] = True
+            out["pipelined_fused_chunks"] = matcher.pipelined_fused_chunks
+            out["pipelined_fused_fallbacks"] = (
+                matcher.pipelined_fused_fallbacks
+            )
+            out["h2d_bytes_per_batch"] = round(
+                matcher.stats.h2d_bytes_per_batch(), 1
+            )
     lps = total / elapsed
     out["value"] = round(lps, 1)
     out["vs_baseline"] = round(lps / TARGET, 4)
     out["elapsed_s"] = round(elapsed, 2)
+    if mode == "pipeline" and device_windows:
+        # the acceptance row: --pipeline with device windows banks a
+        # fused-pipelined row in BENCH_fused_pipeline.json — and ONLY
+        # there: its workload (device windows on) is not comparable to
+        # BENCH_pipeline.json's host-window sync row, so it must not
+        # clobber that book's pipeline row or its speedup
+        try:
+            with open(FUSED_STREAM_PATH) as f:
+                fbook = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            fbook = {}
+        fbook["pipeline_device_windows_row"] = out
+        ftmp = FUSED_STREAM_PATH + ".tmp"
+        with open(ftmp, "w") as f:
+            json.dump(fbook, f, indent=1)
+        os.replace(ftmp, FUSED_STREAM_PATH)
+        head = ["metric", "value", "unit", "vs_baseline", "backend", "mode"]
+        ordered = {k: out[k] for k in head if k in out}
+        ordered.update({k: v for k, v in out.items() if k not in ordered})
+        print(json.dumps(ordered))
+        return
 
     # merge into BENCH_pipeline.json (atomic) and report the speedup when
     # both modes have been measured on this backend
@@ -978,6 +1130,9 @@ def _compose(partial: dict, live_sections: "set", probe: str,
 
 
 def main() -> None:
+    if "--fused-pipeline" in sys.argv:
+        _fused_pipeline_mode()
+        return
     if "--pipeline" in sys.argv:
         _stream_mode("pipeline")
         return

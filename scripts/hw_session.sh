@@ -70,6 +70,18 @@ BENCH_SECTIONS=ladder BENCH_BUDGET_S=900 timeout 1200 python bench.py
 # nothing)
 timeout 900 python scripts/hw_sweep.py 600 || true
 
+# 4b. streaming-pipeline acceptance rows on the real chip: classic-bitmap
+# pipelined vs sync (BENCH_pipeline.json), then the fused two-phase vs
+# classic A/B with device windows (BENCH_fused_pipeline.json — the
+# h2d-bytes witness that the dense re-upload is gone rides along), then
+# the sharded pipelined dryrun record. Each step banks its own artifact,
+# so a tunnel wedge costs at most the row in flight.
+timeout 900 python bench.py --sync || true
+timeout 900 python bench.py --pipeline || true
+timeout 900 python bench.py --fused-pipeline || true
+BENCH_STREAM_DEVICE_WINDOWS=1 timeout 900 python bench.py --pipeline || true
+timeout 600 python __graft_entry__.py || true
+
 # 5. re-bank the two headline sections (tpu rows overwrite tpu rows,
 # newest wins; a re-run with warm compile caches is usually the cleaner
 # number)

@@ -52,9 +52,10 @@ class _Sink:
                 self.results.extend(results)
 
 
-def build(threshold=3, health=None):
+def build(threshold=3, health=None, device_windows=False):
     cfg = config_from_yaml_text(RULES_YAML)
     cfg.breaker_failure_threshold = threshold
+    cfg.matcher_device_windows = device_windows
     states = RegexRateLimitStates()
     banner = MockBanner()
     m = TpuMatcher(
@@ -172,6 +173,173 @@ def test_overload_shed_plus_collect_fault_still_accounts():
     )
     assert_accounted(sched, sink, lines)
     assert sched.stats.shed_lines > 0
+
+
+class TestFusedTwoPhaseFaults:
+    """The same no-silent-loss contract with the fused matcher+windows
+    two-phase path active (device windows on → program A at submit, the
+    window commit at drain).  The extra hazard class here is LEAKED ORDER
+    TURNS: a chunk whose apply never runs must free its resolve/collect
+    turns and slot pins, or every later fused drain deadlocks — which
+    these streams would surface as a flush() timeout."""
+
+    def test_fused_stream_accounts_and_engages(self):
+        m, banner = build(device_windows=True)
+        lines, sink, sched = run_stream(m)
+        assert_accounted(sched, sink, lines)
+        assert sched.stats.processed_lines == len(lines)
+        # the two-phase path ran (commit or counted overflow fallback)
+        assert m.pipelined_fused_chunks + m.pipelined_fused_fallbacks > 0
+        assert len(banner.regex_ban_logs) == len(lines)
+
+    def test_device_failpoint_under_fused_path_loses_nothing(self):
+        """matcher.device armed: fused submits fail → entries abandoned →
+        batches drain generically via the CPU reference.  No deadlock, no
+        loss, breaker opens."""
+        m, banner = build(threshold=2, device_windows=True)
+        failpoints.arm("matcher.device")
+        lines, sink, sched = run_stream(m, n_chunks=16)
+        assert_accounted(sched, sink, lines)
+        assert sched.stats.processed_lines == len(lines)
+        assert m.breaker.state == OPEN
+        assert len(banner.regex_ban_logs) == len(lines)
+
+    def test_failed_then_recovered_device_does_not_wedge_fused_drains(self):
+        """Phase A streams with the device failing (fused submits abandon
+        their entries, batches drain generically); phase B disarms and
+        streams again THROUGH THE SAME matcher — the fused path must
+        engage and drain (a leaked order turn from phase A would hang
+        phase B's flush)."""
+        m, banner = build(threshold=100, device_windows=True)
+        now = time.time()
+        sink = _Sink()
+        sched = PipelineScheduler(
+            lambda: m, on_results=sink, now_fn=lambda: now
+        )
+        sched.start()
+        lines = []
+        failpoints.arm("matcher.device", count=8)
+        for c in range(8):
+            batch = [
+                f"{now:.6f} 9.9.{c}.{i} GET h.com GET /attack HTTP/1.1 ua -"
+                for i in range(25)
+            ]
+            lines.extend(batch)
+            sched.submit(batch)
+            assert sched.flush(60)  # one batch per chunk, failpoint per batch
+        failpoints.disarm()
+        for c in range(8, 14):
+            batch = [
+                f"{now:.6f} 9.9.{c}.{i} GET h.com GET /attack HTTP/1.1 ua -"
+                for i in range(25)
+            ]
+            lines.extend(batch)
+            sched.submit(batch)
+        assert sched.flush(60), "phase B hung — leaked fused order turn"
+        sched.stop()
+        assert_accounted(sched, sink, lines)
+        assert sched.stats.processed_lines == len(lines)
+        assert m.pipelined_fused_chunks + m.pipelined_fused_fallbacks > 0
+        assert len(banner.regex_ban_logs) == len(lines)
+
+    def test_drain_failpoint_under_fused_path_frees_turns(self):
+        """pipeline.drain fires before pipeline_finish: the batch's
+        two-phase chunks are settled by pipeline_abort — the stream after
+        the failed batch still drains (no leaked turn deadlock)."""
+        m, _ = build(device_windows=True)
+        failpoints.arm("pipeline.drain", count=2)
+        lines, sink, sched = run_stream(m, n_chunks=14)
+        assert_accounted(sched, sink, lines)
+        assert sched.stats.drain_error_lines > 0
+        assert sched.stats.processed_lines == (
+            len(lines) - sched.stats.drain_error_lines
+        )
+
+    def test_collect_failpoint_under_fused_path(self):
+        m, banner = build(device_windows=True)
+        failpoints.arm("pipeline.collect", count=3)
+        lines, sink, sched = run_stream(m)
+        assert_accounted(sched, sink, lines)
+        assert sched.stats.processed_lines == len(lines)
+        assert len(banner.regex_ban_logs) == len(lines)
+
+
+class TestCommandRouting:
+    """Kafka command messages through the admission buffer: the
+    admitted == processed + shed invariant spans both producers."""
+
+    def test_commands_share_accounting_with_lines(self):
+        m, _ = build()
+        now = time.time()
+        sink = _Sink()
+        handled = []
+        sched = PipelineScheduler(
+            lambda: m, on_results=sink, now_fn=lambda: now
+        )
+        sched.start()
+        total = 0
+        for c in range(8):
+            batch = [
+                f"{now:.6f} 9.9.{c}.{i} GET h.com GET /attack HTTP/1.1 ua -"
+                for i in range(10)
+            ]
+            sched.submit(batch)
+            sched.submit_commands(
+                [f"cmd-{c}-{k}".encode() for k in range(3)], handled.append
+            )
+            total += 13
+        assert sched.flush(60)
+        sched.stop()
+        s = sched.stats
+        assert s.admitted_lines == total
+        assert s.admitted_lines == (
+            s.processed_lines + s.shed_lines + s.drain_error_lines
+        )
+        assert s.command_items == 24
+        assert handled == [
+            f"cmd-{c}-{k}".encode() for c in range(8) for k in range(3)
+        ], "commands executed out of admission order"
+        # on_results only sees log lines, never command items
+        assert len(sink.lines) == total - 24
+
+    def test_command_overload_sheds_and_counts(self):
+        m, _ = build()
+        handled = []
+        sched = PipelineScheduler(
+            lambda: m, ring_size=1, buffer_lines=16, max_block_ms=0.0,
+            min_batch=64, max_batch=64,
+        )
+        sched.start()
+        for c in range(40):
+            sched.submit_commands(
+                [f"c{c}-{k}".encode() for k in range(4)], handled.append
+            )
+        assert sched.flush(60)
+        sched.stop()
+        s = sched.stats
+        assert s.admitted_lines == 160
+        assert s.shed_lines > 0
+        assert s.admitted_lines == (
+            s.processed_lines + s.shed_lines + s.drain_error_lines
+        )
+        assert len(handled) == s.processed_lines
+
+    def test_bad_command_loses_itself_not_the_batch(self):
+        m, _ = build()
+        good = []
+
+        def handler(raw):
+            if raw == b"boom":
+                raise ValueError("bad command")
+            good.append(raw)
+
+        sched = PipelineScheduler(lambda: m)
+        sched.start()
+        sched.submit_commands([b"a", b"boom", b"b"], handler)
+        assert sched.flush(30)
+        sched.stop()
+        assert good == [b"a", b"b"]
+        assert sched.stats.processed_lines == 3  # boom counted, logged
 
 
 def test_pipeline_registers_health_and_degrades_on_shed():

@@ -73,3 +73,57 @@ def assert_mesh_matches_cpu_oracle(
             "fused mesh prefilter never ran"
         )
     return tpu_m
+
+
+def assert_pipelined_mesh_matches_cpu_oracle(
+    yaml_text, lines, now, n_devices, rp, *,
+    interpret=False, device_windows=False,
+):
+    """The streaming pipeline scheduler driving a mesh-mode TpuMatcher
+    (sharded submit → per-shard merge at collect → ordered window commit
+    at drain) against the CPU reference.  Returns the shed-line count
+    (asserted 0) so dryruns can print it."""
+    import threading
+
+    from banjax_tpu.pipeline import PipelineScheduler
+
+    cpu_m, cpu_b = build_matcher(CpuMatcher, yaml_text)
+    want = [cpu_m.consume_line(l, now) for l in lines]
+
+    tpu_m, tpu_b = build_matcher(
+        TpuMatcher, yaml_text, mesh_devices=n_devices, mesh_rp=rp,
+        interpret=interpret, device_windows=device_windows,
+    )
+    assert tpu_m._mesh_matcher is not None, "mesh mode did not engage"
+    collected = []
+    lock = threading.Lock()
+
+    def sink(batch_lines, results):
+        with lock:
+            collected.append((batch_lines, results))
+
+    sched = PipelineScheduler(
+        lambda: tpu_m, on_results=sink, now_fn=lambda: now,
+    )
+    sched.start()
+    step = max(1, len(lines) // 5)
+    for i in range(0, len(lines), step):
+        sched.submit(lines[i : i + step])
+    assert sched.flush(300), "pipelined mesh stream did not drain"
+    sched.stop()
+
+    got_lines = [l for ls, _ in collected for l in ls]
+    got = [r for _, rs in collected for r in rs]
+    assert got_lines == list(lines), "admission order broken"
+    assert [result_key(r) for r in got] == [result_key(r) for r in want], (
+        "pipelined mesh TpuMatcher diverged from the CPU oracle"
+    )
+    assert [(b.ip, b.decision, b.domain) for b in tpu_b.bans] == [
+        (b.ip, b.decision, b.domain) for b in cpu_b.bans
+    ], "Banner side effects diverged"
+    # the sharded drain actually merged per-shard pulls (not a silent
+    # single-array fallback)
+    assert tpu_m._mesh_matcher.last_shard_merge_ms, "per-shard merge never ran"
+    snap = sched.snapshot()
+    assert snap["PipelineShedLines"] == 0
+    return snap["PipelineShedLines"]

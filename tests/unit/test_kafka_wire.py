@@ -382,3 +382,137 @@ def test_skipped_batches_surface_on_metrics_line(_snappy_counter):
     assert "KafkaSkippedBatches" not in metrics_line()
     _decode_record_batches(_record_batch_with_codec(b"\x00", attrs=3))
     assert metrics_line()["KafkaSkippedBatches"] == 1
+
+
+# ---------------------------------------------------------------- lz4 (codec 3)
+
+
+def test_lz4_roundtrip_through_compressor():
+    for payload in (b"", b"x", b"hello lz4 " * 500, bytes(range(256)) * 400):
+        assert kafka_wire.lz4_decompress(
+            kafka_wire.lz4_compress(payload)
+        ) == payload
+
+
+def test_lz4_block_with_back_reference_decodes():
+    # literals "abcd" + match(offset=4, len=4+4) -> "abcdabcdabcd": the
+    # overlapping-copy idiom a real encoder emits for repeats
+    blk = bytes([0x44]) + b"abcd" + bytes([0x04, 0x00])
+    assert kafka_wire._lz4_decode_block(blk) == b"abcdabcdabcd"
+    # extended literal (15 + extra byte) and extended match length forms
+    lit = b"x" * 20
+    blk = bytes([0xFF, 20 - 15]) + lit + bytes([0x04, 0x00, 15 - 15])
+    # token: lit=15(+5)=20, mlen=15(+0)+4=19, offset 4
+    out = kafka_wire._lz4_decode_block(blk)
+    assert out == lit + b"x" * 19
+
+
+def test_lz4_xxh32_vectors():
+    # reference vectors from the xxHash spec
+    assert kafka_wire.xxh32(b"") == 0x02CC5D05
+    assert kafka_wire.xxh32(b"Hello World") == 0xB1FD16EE
+
+
+def test_lz4_truncated_and_bad_offset_raise():
+    good = kafka_wire.lz4_compress(b"payload bytes here")
+    with pytest.raises(kafka_wire.KafkaWireError):
+        kafka_wire.lz4_decompress(good[:10])
+    with pytest.raises(kafka_wire.KafkaWireError):
+        kafka_wire.lz4_decompress(b"\x00\x01\x02\x03garbage")
+    # match offset pointing before the start of the output
+    with pytest.raises(kafka_wire.KafkaWireError):
+        kafka_wire._lz4_decode_block(bytes([0x14]) + b"a" + bytes([0x09, 0x00]))
+
+
+def test_lz4_record_batch_v2_decodes(_snappy_counter):
+    record_body = (b"\x00" + _varint(0) + _varint(0) + _varint(-1) +
+                   _varint(6) + b"lz4win" + _varint(0))
+    record = _varint(len(record_body)) + record_body
+    full = _record_batch_with_codec(kafka_wire.lz4_compress(record), attrs=3)
+    assert _decode_record_batches(full) == [(0, b"lz4win")]
+    assert kafka_wire.skipped_batch_count() == 0
+
+
+def test_lz4_message_set_wrapper_decodes(_snappy_counter):
+    inner = _encode_message_set_v1(b"old-lz4", 1234, offset=9)
+    wrapper = _encode_message_set_v1(
+        kafka_wire.lz4_compress(inner), 1234, offset=9
+    )
+    wrapper = wrapper[:17] + bytes([3]) + wrapper[18:]  # attrs -> codec 3
+    assert _decode_message_set(wrapper) == [(9, b"old-lz4")]
+    assert kafka_wire.skipped_batch_count() == 0
+
+
+def test_corrupt_lz4_and_zstd_still_skip_counted(_snappy_counter):
+    # a corrupt lz4 batch is counted + skipped (never fatal); zstd stays
+    # skip-counted unconditionally — the KafkaSkippedBatches contract
+    assert _decode_record_batches(
+        _record_batch_with_codec(b"\x00\x01\x02", attrs=3)
+    ) == []
+    assert _decode_record_batches(
+        _record_batch_with_codec(b"(\xb5/\xfd data", attrs=4)
+    ) == []
+    assert kafka_wire.skipped_batch_count() == 2
+
+
+# ------------------------------------------------- kafka -> pipeline routing
+
+
+def test_kafka_reader_routes_commands_through_pipeline():
+    """ROADMAP PR 2 follow-up: with a pipeline wired, the reader admits
+    each message into the scheduler's buffer (shared backpressure and
+    accounting) and the drain thread dispatches it — decision lists end
+    up identical to the inline path."""
+    import threading
+
+    from banjax_tpu.pipeline import PipelineScheduler
+
+    cfg = make_config(0)
+
+    class Holder:
+        def get(self):
+            return cfg
+
+    class ListTransport(kafka_io.KafkaTransport):
+        def __init__(self, msgs):
+            self.msgs = msgs
+            self.done = threading.Event()
+
+        def read_messages(self, config, topic, partition):
+            for m in self.msgs:
+                yield m
+            self.done.set()
+            while not self.done.wait(0.05):
+                pass  # park: reader keeps iterating until stop()
+
+        def close(self):
+            self.done.set()
+
+    msgs = [
+        json.dumps({"Name": "challenge_ip", "Value": f"5.6.7.{i}",
+                    "host": "example.com"}).encode()
+        for i in range(5)
+    ] + [b"not json"]
+
+    class NullMatcher:
+        def consume_lines(self, lines, now_unix=None):
+            return [None for _ in lines]
+
+    sched = PipelineScheduler(lambda: NullMatcher())
+    sched.start()
+    lists = DynamicDecisionLists(start_sweeper=False)
+    transport = ListTransport(msgs)
+    reader = kafka_io.KafkaReader(
+        Holder(), lists, transport, pipeline=sched
+    )
+    reader.start()
+    assert transport.done.wait(5)
+    assert sched.flush(30)
+    reader.stop()
+    sched.stop()
+    for i in range(5):
+        decision, _ = lists.check("", f"5.6.7.{i}")
+        assert decision is not None and decision.decision == Decision.CHALLENGE
+    s = sched.stats
+    assert s.command_items == 6  # the bad message is counted too, not lost
+    assert s.admitted_lines == s.processed_lines + s.shed_lines

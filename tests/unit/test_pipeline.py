@@ -259,6 +259,82 @@ class TestSplitProtocol:
         assert all(r.old_line for r in results)
 
 
+class TestFusedTwoPhaseSplit:
+    """The fused matcher+windows two-phase protocol under the split calls
+    (device windows on → submit dispatches program A, finish commits)."""
+
+    def test_multi_chunk_batch_commits_in_order(self):
+        """A batch wider than matcher_batch_lines splits into several
+        two-phase chunks; their B-applies commit strictly in chunk order
+        at finish — identical to the sync fused path."""
+        now = time.time()
+        # mixed traffic: mostly benign so the candidate gate holds
+        lines = [
+            f"{now:.6f} 1.2.{i % 5}.{i % 9} GET h.com GET "
+            f"/{'attack' if i % 11 == 0 else 'page'}{i % 3} HTTP/1.1 ua -"
+            for i in range(300)
+        ]
+        sync_m, _, sync_banner = make_matcher(
+            device_windows=True, matcher_batch_lines=64
+        )
+        want = sync_m.consume_lines(lines, now)
+
+        m, _, banner = make_matcher(
+            device_windows=True, matcher_batch_lines=64
+        )
+        state = m.pipeline_begin(lines, now)
+        assert state.get("fused_eligible")
+        m.pipeline_submit(state)
+        assert len(state["fused"]) > 1, "expected several two-phase chunks"
+        m.pipeline_collect(state)
+        got, n_stale = m.pipeline_finish(state, now)
+        assert n_stale == 0
+        assert m.pipelined_fused_chunks == len(
+            [1 for _ in range(0, 300, 64)]
+        ) - m.pipelined_fused_fallbacks
+        for a, b in zip(want, got):
+            assert [
+                (r.rule_name, r.regex_match, r.seen_ip,
+                 r.rate_limit_result and r.rate_limit_result.exceeded)
+                for r in a.rule_results
+            ] == [
+                (r.rule_name, r.regex_match, r.seen_ip,
+                 r.rate_limit_result and r.rate_limit_result.exceeded)
+                for r in b.rule_results
+            ]
+        assert sync_banner.regex_ban_logs == banner.regex_ban_logs
+        assert sync_m.device_windows.format_states() == \
+            m.device_windows.format_states()
+
+    def test_pipeline_fused_false_restores_classic_protocol(self):
+        now = time.time()
+        m, _, _ = make_matcher(device_windows=True, pipeline_fused=False)
+        state = m.pipeline_begin(lines_at(now, 20), now)
+        assert not state.get("fused_eligible")
+        m.pipeline_submit(state)
+        assert state.get("fused") is None and state["pend"] is not None
+        m.pipeline_collect(state)
+        results, _ = m.pipeline_finish(state, now)
+        assert m.pipelined_fused_chunks == 0
+
+    def test_abort_frees_turns_for_later_batches(self):
+        """pipeline_abort on an un-finished batch must free its order
+        turns: a later batch's finish would otherwise deadlock."""
+        now = time.time()
+        m, _, _ = make_matcher(device_windows=True)
+        s1 = m.pipeline_begin(lines_at(now, 10), now)
+        m.pipeline_submit(s1)
+        assert s1.get("fused")
+        s2 = m.pipeline_begin(lines_at(now, 10), now)
+        m.pipeline_submit(s2)
+        m.pipeline_abort(s1)  # batch 1 dies before its drain
+        m.pipeline_collect(s2)
+        results, _ = m.pipeline_finish(s2, now)  # must not hang
+        assert any(r.rule_results for r in results)
+        # pins fully released: every slot usable again
+        assert (m.device_windows._pin_counts == 0).all()
+
+
 # ---------------------------------------------------------------------------
 # scheduler (threads)
 # ---------------------------------------------------------------------------
