@@ -238,6 +238,31 @@ class Config:
     # buffer (same bounded-block/oldest-first-shed accounting as tailer
     # lines); only meaningful when pipeline_enabled is true
     pipeline_kafka: bool = True
+    # --- parallel host path ---
+    # sharded encode workers for the pipeline's host stage: each
+    # admission batch splits into contiguous row shards parsed/gated on
+    # a thread pool (the native parse is GIL-free), then merged back in
+    # strict line order — output is byte-identical to single-thread.
+    # -1 = auto (min(4, cores); 0 on a single-core host), 0 = the
+    # single-thread encode path.
+    encode_workers: int = -1
+    # native C slot manager for the device-windows ip->slot table
+    # (native/slotmgr.c): the whole per-distinct-IP assignment loop runs
+    # as one C call per batch, with exact Python-path parity.  Auto-falls
+    # back to the Python dict path when no C compiler is present; false
+    # forces the dict path (the differential oracle).
+    slotmgr_native: bool = True
+    # resolve-ahead depth for the fused drain commit: 2 dispatches chunk
+    # i+1's window program while chunk i's events decode, overlapping the
+    # fixed device->host pull instead of serializing the drain thread;
+    # 1 restores the serial drain.
+    drain_resolve_depth: int = 2
+    # take-size bound for command batches in the pipeline's encode stage:
+    # commands carry no device timing for the adaptive sizer, so a Kafka
+    # command flood is chopped into batches of at most this many messages
+    # instead of riding the (much larger) adaptive line bucket and
+    # starving line batching.
+    pipeline_command_take_max: int = 1024
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -279,6 +304,8 @@ _SCALAR_KEYS = {
     "pipeline_latency_budget_ms": float, "pipeline_buffer_lines": int,
     "pipeline_max_block_ms": float, "matcher_probe_seconds": float,
     "pipeline_fused": bool, "pipeline_kafka": bool,
+    "encode_workers": int, "slotmgr_native": bool,
+    "drain_resolve_depth": int, "pipeline_command_take_max": int,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -411,6 +438,21 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
             "config keys pipeline_max_block_ms/matcher_probe_seconds: "
             f"expected non-negative, got {cfg.pipeline_max_block_ms}/"
             f"{cfg.matcher_probe_seconds}"
+        )
+    if cfg.encode_workers < -1:
+        raise ValueError(
+            "config key encode_workers: expected -1 (auto), 0 (single-"
+            f"thread) or a positive worker count, got {cfg.encode_workers}"
+        )
+    if cfg.drain_resolve_depth < 1:
+        raise ValueError(
+            "config key drain_resolve_depth: expected >= 1, got "
+            f"{cfg.drain_resolve_depth}"
+        )
+    if cfg.pipeline_command_take_max < 1:
+        raise ValueError(
+            "config key pipeline_command_take_max: expected >= 1, got "
+            f"{cfg.pipeline_command_take_max}"
         )
 
     return cfg

@@ -43,6 +43,7 @@ from banjax_tpu.matcher.api import ConsumeLineResult, Matcher, RuleResult
 from banjax_tpu.matcher.cpu_ref import OLD_LINE_CUTOFF_SECONDS
 from banjax_tpu.matcher.encode import ParsedLine, encode_for_match, parse_line
 from banjax_tpu.matcher.workset import (
+    CompositeWork,
     LazyResults,
     ListWork,
     NativeWork,
@@ -100,6 +101,15 @@ class TpuMatcher(Matcher):
         # pipeline_fused=false restores the PR 2 behavior: the split
         # protocol always takes the classic bitmap path
         self._pipeline_fused = bool(getattr(config, "pipeline_fused", True))
+        # resolve-ahead depth for the fused drain commit: at depth d the
+        # drain keeps up to d-1 resolved chunks pending, so chunk i+1's
+        # window program (B) is on the device while chunk i's events
+        # decode/replay — the ~65 ms fixed d2h pull overlaps instead of
+        # serializing the drain thread.  1 restores the serial drain.
+        self._drain_resolve_depth = max(
+            1, int(getattr(config, "drain_resolve_depth", 2))
+        )
+        self.drain_resolve_overlap_ms_ewma: Optional[float] = None
         self._cpu_fallback = None
         self._health_registry = health
         self._health = health.register("matcher") if health is not None else None
@@ -208,6 +218,7 @@ class TpuMatcher(Matcher):
             self.device_windows = DeviceWindows(
                 [r for _, r in self._entries],
                 capacity=getattr(config, "matcher_window_capacity", 0),
+                native_slotmgr=getattr(config, "slotmgr_native", True),
             )
             # active_table[h, rid]: rule rid applies to lines of host row h
             # (per-site rules of that host + global rules), minus
@@ -487,7 +498,8 @@ class TpuMatcher(Matcher):
                 f"breaker {state}; batches on CPU reference matcher",
             )
 
-    def _gate(self, lines, now, results, use_scratch=True):
+    def _gate(self, lines, now, results, use_scratch=True,
+              parse_threads=None):
         """Step 1: host parse + allowlist exemption
         (regex_rate_limiter.go:131-172) — one native C pass when available
         (banjax_tpu/native), with the Python reference path per deferred
@@ -507,6 +519,7 @@ class TpuMatcher(Matcher):
                 lines, self.compiled.byte_to_class, self._max_len, now,
                 OLD_LINE_CUTOFF_SECONDS,
                 scratch=self._parse_scratch if use_scratch else None,
+                max_threads=parse_threads,
             )
         if nb is not None:
             work, pre_encoded = self._native_gate(
@@ -634,6 +647,65 @@ class TpuMatcher(Matcher):
         work, pre_encoded = self._gate(
             lines, now, results, use_scratch=False
         )
+        return self._pipeline_state(lines, results, work, pre_encoded)
+
+    def encode_shard(self, lines: Sequence[str], now: float):
+        """One row shard of the encode stage: parse + gate + encode over
+        a contiguous slice of the admission batch, fresh buffers (shards
+        run concurrently on the scheduler's worker pool — the native
+        parse and the columnar gate are GIL-free/thread-safe).  Returned
+        indices are LOCAL to the shard; pipeline_begin_from_shards
+        rebases them."""
+        results = LazyResults(len(lines))
+        work, pre_encoded = self._gate(
+            lines, now, results, use_scratch=False, parse_threads=1
+        )
+        return work, pre_encoded, results
+
+    def pipeline_begin_from_shards(
+        self, lines: Sequence[str], now: float, shards
+    ) -> dict:
+        """Merge encode_shard outputs back into the exact state
+        pipeline_begin would have produced single-threaded.  `shards` is
+        [(row0, (work, pre, results)), ...] in row order, covering
+        `lines` exactly.  The merge is strict line order end to end:
+        results rows rebase by row0, work sets concatenate positionally
+        (workset.CompositeWork), the encoded arrays concatenate row-wise,
+        and the merged unique-IP table is in global first-appearance
+        order — so slot assignment, window events, and ban-log bytes are
+        byte-identical to the single-thread path
+        (tests/differential/test_host_parallel_differential.py)."""
+        results = LazyResults(len(lines))
+        parts, offsets, pres = [], [], []
+        native_pre = True
+        for row0, (work, pre, shard_results) in shards:
+            results.absorb(shard_results, row0)
+            if not len(work):
+                continue
+            parts.append(work)
+            offsets.append(row0)
+            if pre is None:
+                native_pre = False
+            else:
+                pres.append(pre)
+        if not parts:
+            work, pre_encoded = ListWork(), None
+        elif len(parts) == 1 and offsets[0] == 0:
+            work = parts[0]
+            pre_encoded = pres[0] if (native_pre and pres) else None
+        else:
+            work = CompositeWork(parts, offsets)
+            # a python-parsed shard (no native lib mid-flight) has no
+            # encoded arrays: the merged batch re-encodes from rests —
+            # correctness first, the fast path needs every shard native
+            pre_encoded = None
+            if native_pre:
+                pre_encoded = tuple(
+                    np.concatenate([p[k] for p in pres]) for k in range(3)
+                )
+        return self._pipeline_state(lines, results, work, pre_encoded)
+
+    def _pipeline_state(self, lines, results, work, pre_encoded) -> dict:
         state = {
             "lines": lines, "results": results, "work": work,
             "pre": pre_encoded, "pend": None, "bits": None,
@@ -773,22 +845,70 @@ class TpuMatcher(Matcher):
             )
 
     def _finish_fused_pipeline(self, state, stale, results) -> None:
-        """Ordered window commit for the two-phase chunks: resolve each
-        chunk (dispatching program B with the stale rows masked out),
-        collect its events, replay.  Overflow falls back to the classic
-        replay mid-pipeline; a failed chunk loses only its own lines —
-        its order turns and pins are freed either way, so later chunks
-        (and later batches) keep draining."""
+        """Ordered window commit for the two-phase chunks, with depth-
+        `drain_resolve_depth` resolve-ahead: up to depth-1 RESOLVED
+        chunks stay pending while the next chunk's resolve dispatches its
+        window program (B) — so chunk i's event pull/decode/replay runs
+        while chunk i+1's B computes on the device, hiding the fixed d2h
+        latency the serial drain paid per chunk (ROADMAP PR 3 follow-up).
+
+        Ordering is untouched: resolve order == B dispatch order ==
+        device apply order (the pipeline's turn machinery enforces it),
+        and replay — hence ban-log byte order — still happens strictly
+        chunk-ascending because pending chunks drain before any later
+        chunk's fallback/replay emits an effect.  Staleness masks and the
+        overflow fallback compose exactly as at depth 1: a stale-masked
+        chunk resolves with its live mask, an overflowing chunk first
+        drains every pending replay, then replays classically.  A failed
+        chunk loses only its own lines — its order turns and pins are
+        freed either way (fused_windows' dead-turn sweep), so later
+        chunks and later batches keep draining."""
         entries = state["fused"]
         state["fused"] = None
         fw = self._fw_pipeline
         from banjax_tpu.matcher.fused_windows import PipelineOverflow
+
+        depth = self._drain_resolve_depth
+        pending: List[dict] = []  # resolved, replay deferred (≤ depth-1)
+
+        def collect_replay(e, overlapped: bool) -> None:
+            pend = e["pend"]
+            t0 = time.perf_counter()
+            try:
+                res = fw.collect(pend)
+                self._replay_window_events(
+                    e["work"], None, (res.matched_pairs, res.always_bits),
+                    res.events, results, live_rows=e["live"],
+                )
+                self.pipelined_fused_chunks += 1
+            except Exception:  # noqa: BLE001 — collect released pins/turns in finally
+                log.exception(
+                    "pipelined fused event collect failed; chunk lines "
+                    "marked error"
+                )
+                self._mark_chunk_error(e, e["chunk_stale"], results)
+                self.note_device_outcome(0.0, ok=False)
+            finally:
+                self.stats.note_xfer(pend.h2d_bytes, pend.d2h_bytes)
+            if overlapped:
+                # the d2h-overlap witness: this collect+replay wall time
+                # ran while a later chunk's B was in flight
+                ms = (time.perf_counter() - t0) * 1e3
+                prev = self.drain_resolve_overlap_ms_ewma
+                self.drain_resolve_overlap_ms_ewma = (
+                    ms if prev is None else prev + 0.3 * (ms - prev)
+                )
+
+        def drain_pending() -> None:
+            while pending:
+                collect_replay(pending.pop(0), overlapped=False)
 
         for e in entries:
             pend = e["pend"]
             s = e["row0"]
             n = len(e["work"])
             chunk_stale = stale[s : s + n]
+            e["chunk_stale"] = chunk_stale
             live = None
             if chunk_stale.any():
                 if chunk_stale.all():
@@ -797,9 +917,14 @@ class TpuMatcher(Matcher):
                     fw.abandon(pend)
                     continue
                 live = ~chunk_stale
+            e["live"] = live
             try:
+                failpoints.check("matcher.resolve")
                 fw.resolve(pend, live=live)
             except PipelineOverflow as ov:
+                # earlier chunks' effects must fire before this chunk's
+                # classic replay: drain the resolve-ahead window first
+                drain_pending()
                 self.pipelined_fused_fallbacks += 1
                 try:
                     self._pipeline_fallback_entry(e, ov, results, live=live)
@@ -812,7 +937,13 @@ class TpuMatcher(Matcher):
                     self.note_device_outcome(0.0, ok=False)
                 self.stats.note_xfer(pend.h2d_bytes, pend.d2h_bytes)
                 continue
-            except Exception:  # noqa: BLE001 — resolve freed the turns/pins already
+            except Exception:  # noqa: BLE001 — resolve frees turns/pins on its own errors
+                # an abort BEFORE resolve (the matcher.resolve failpoint)
+                # leaves the chunk submitted: settle its turns/pins here
+                # so the dead-turn sweep keeps later drains alive
+                if pend.state == "submitted":
+                    fw.abandon(pend)
+                drain_pending()
                 log.exception(
                     "pipelined fused window commit failed; chunk lines "
                     "marked error"
@@ -820,22 +951,11 @@ class TpuMatcher(Matcher):
                 self._mark_chunk_error(e, chunk_stale, results)
                 self.note_device_outcome(0.0, ok=False)
                 continue
-            try:
-                res = fw.collect(pend)
-                self._replay_window_events(
-                    e["work"], None, (res.matched_pairs, res.always_bits),
-                    res.events, results, live_rows=live,
-                )
-                self.pipelined_fused_chunks += 1
-            except Exception:  # noqa: BLE001 — collect released pins/turns in finally
-                log.exception(
-                    "pipelined fused event collect failed; chunk lines "
-                    "marked error"
-                )
-                self._mark_chunk_error(e, chunk_stale, results)
-                self.note_device_outcome(0.0, ok=False)
-            finally:
-                self.stats.note_xfer(pend.h2d_bytes, pend.d2h_bytes)
+            pending.append(e)
+            while len(pending) > depth - 1:
+                head = pending.pop(0)
+                collect_replay(head, overlapped=bool(pending))
+        drain_pending()
 
     def _mark_chunk_error(self, e, chunk_stale, results) -> None:
         for k in np.flatnonzero(~chunk_stale):

@@ -320,6 +320,7 @@ class DeviceWindows:
         rules: Sequence[RegexWithRate],
         capacity: int = 16384,  # matcher_window_capacity; 0 = auto-size
         max_events: int = 4096,
+        native_slotmgr: bool = True,
     ):
         self.n_rules = max(1, len(rules))
         # capacity 0 = auto: start small, double on occupancy pressure
@@ -361,6 +362,21 @@ class DeviceWindows:
         self._batch_seq = 0
         self._slot_ip: Dict[int, str] = {}
         self._free: List[int] = list(range(capacity - 1, -1, -1))
+        # native slot manager (native/slotmgr.c): the whole per-distinct-
+        # IP assignment loop — hash lookup, free-stack pop, LRU eviction —
+        # runs as one C call per batch over the unique-IP array, with
+        # exact Python-path parity (tests/unit/test_slotmgr.py).  The
+        # dict loop below stays as the fallback (no C compiler) and the
+        # differential oracle.  _slot_ip mirrors slot→ip in BOTH modes
+        # (shadow updates and restores need the strings); _slots/_free
+        # are dict-path-only.
+        self._sm = None
+        self.slotmgr_native = False
+        if native_slotmgr:
+            from banjax_tpu.native import slotmgr as _slotmgr
+
+            self._sm = _slotmgr.create(capacity)
+            self.slotmgr_native = self._sm is not None
         self._pending_evict: List[int] = []
         self._pending_restore: List[Tuple[int, str]] = []
         # slots handed out by slots_for_ips stay pinned until the matching
@@ -449,9 +465,13 @@ class DeviceWindows:
         churn); eviction scans argmin(last_used) over evictable slots —
         O(capacity) but evictions are rare by design (auto-grow absorbs
         distinct-IP pressure first), and which victim is chosen is not a
-        parity surface (spill is lossless either way)."""
+        parity surface (spill is lossless either way — though the native
+        manager reproduces the argmin victim exactly, so the parity fuzz
+        can compare slot ids verbatim)."""
         with self._lock:
             self._batch_seq += 1
+            if self._sm is not None:
+                return self._slots_unique_native_locked(ips)
             out = np.empty(len(ips), dtype=np.int32)
             misses: List[int] = []
             get = self._slots.get
@@ -495,6 +515,90 @@ class DeviceWindows:
             self._pin_counts[out] += 1
             return out
 
+    def _slots_unique_native_locked(self, ips: Sequence[str]) -> Optional[np.ndarray]:
+        """slots_for_unique_ips via the native manager: one C lookup pass
+        (hits touched), the Python growth chain between passes, one C
+        placement pass (free stack, then exact-argmin eviction).  Python
+        work is O(misses + evictions) dict bookkeeping only."""
+        sm = self._sm
+        slots, miss_idx, ctx = sm.lookup_batch(
+            ips, self._batch_seq, self._last_used
+        )
+        n_miss = len(miss_idx)
+        if n_miss:
+            # replicate the dict path's per-miss doubling chain: grow
+            # while the free pool cannot absorb the remaining misses and
+            # the ceiling allows — the same final capacity the
+            # grow-on-empty loop reaches
+            new_cap = self.capacity
+            free_cnt = new_cap - len(self._slot_ip)
+            steps = 0
+            while (
+                free_cnt < n_miss
+                and self.auto_grow
+                and new_cap < self.max_capacity
+            ):
+                step = min(new_cap * 2, self.max_capacity)
+                free_cnt += step - new_cap
+                new_cap = step
+                steps += 1
+            if new_cap != self.capacity:
+                self._grow_locked(new_cap)
+                # one coalesced realloc, but DeviceWindowsGrows counts
+                # logical doublings — keep the metric comparable with the
+                # dict path's grow-per-miss loop
+                self.grow_count += steps - 1
+        placed_idx, evicted, ok = sm.place_misses(
+            ctx, slots, miss_idx, self._batch_seq, self._pin_counts,
+            self._last_used,
+        )
+        if len(evicted):
+            ev = [int(s) for s in evicted]
+            for s in ev:
+                self._slot_ip.pop(s, None)
+            self._pending_evict.extend(ev)
+            if self.eviction_count == 0:
+                self._warn_first_eviction()
+            self.eviction_count += len(ev)
+        if len(placed_idx):
+            shadow = self._shadow
+            pend_restore = self._pending_restore
+            slot_ip = self._slot_ip
+            idx_l = placed_idx.tolist()
+            slot_l = slots[placed_idx].tolist()
+            ip_l = list(map(ips.__getitem__, idx_l))
+            # C-speed mirror update: at the all-distinct-IP shape this
+            # loop IS the residual host cost, so no per-entry Python
+            slot_ip.update(zip(slot_l, ip_l))
+            if shadow:
+                for slot, ip in zip(slot_l, ip_l):
+                    if ip in shadow:
+                        # previously-evicted IP returns: counters re-enter
+                        # the device in the next maintenance step, BEFORE
+                        # any of this batch's events for it are applied
+                        pend_restore.append((slot, ip))
+        if not ok:
+            return None  # every eviction candidate pinned — split
+        self._pin_counts[slots] += 1
+        return slots
+
+    def _warn_first_eviction(self) -> None:
+        import logging
+
+        hint = (
+            "auto-size hit its memory-budget ceiling — "
+            "more HBM or fewer rules would raise it"
+            if self.auto_grow else
+            "raise matcher_window_capacity (or set 0 = "
+            "auto-size) to avoid the churn"
+        )
+        logging.getLogger(__name__).warning(
+            "device-windows capacity (%d slots) exceeded; "
+            "evicting LRU IP state to the host shadow "
+            "(restored on re-admission — %s)",
+            self.capacity, hint,
+        )
+
     def _evict_one_locked(self, batch_slots: np.ndarray) -> Optional[int]:
         """Pick and evict the oldest evictable slot: assigned, not pinned
         by an in-flight batch, and not already handed to THIS batch
@@ -513,21 +617,7 @@ class DeviceWindows:
         self._slots.pop(victim_ip)
         self._pending_evict.append(victim)
         if self.eviction_count == 0:
-            import logging
-
-            hint = (
-                "auto-size hit its memory-budget ceiling — "
-                "more HBM or fewer rules would raise it"
-                if self.auto_grow else
-                "raise matcher_window_capacity (or set 0 = "
-                "auto-size) to avoid the churn"
-            )
-            logging.getLogger(__name__).warning(
-                "device-windows capacity (%d slots) exceeded; "
-                "evicting LRU IP state to the host shadow "
-                "(restored on re-admission — %s)",
-                self.capacity, hint,
-            )
+            self._warn_first_eviction()
         self.eviction_count += 1
         return victim
 
@@ -556,10 +646,14 @@ class DeviceWindows:
             ),
         )
         # pop() takes from the end: keep existing (lower) slots there so
-        # allocation order is unchanged; new high slots drain last
-        self._free = (
-            list(range(new_capacity - 1, old_cap - 1, -1)) + self._free
-        )
+        # allocation order is unchanged; new high slots drain last (the
+        # native manager's free stack replicates the same order)
+        if self._sm is not None:
+            self._sm.grow(new_capacity)
+        else:
+            self._free = (
+                list(range(new_capacity - 1, old_cap - 1, -1)) + self._free
+            )
         self._last_used = np.concatenate(
             [self._last_used, np.zeros(add, dtype=np.int64)]
         )
@@ -588,7 +682,9 @@ class DeviceWindows:
     def occupancy(self) -> int:
         """IP slots currently assigned (capacity-pressure gauge)."""
         with self._lock:
-            return len(self._slots)
+            # _slot_ip mirrors assignments in both the native and dict
+            # modes; _slots is dict-mode-only
+            return len(self._slot_ip)
 
     def clear(self) -> None:
         """Hot-reload semantics: drop all counters (decision.go Clear analog)."""
@@ -596,7 +692,10 @@ class DeviceWindows:
             self._slots.clear()
             self._slot_ip.clear()
             self._shadow.clear()
-            self._free = list(range(self.capacity - 1, -1, -1))
+            if self._sm is not None:
+                self._sm.clear()
+            else:
+                self._free = list(range(self.capacity - 1, -1, -1))
             self._pending_evict = []
             self._pending_restore = []
             self._pin_counts = np.zeros(self.capacity, dtype=np.int32)

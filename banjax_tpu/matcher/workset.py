@@ -37,10 +37,11 @@ class LazyResults:
     construction of 65k dataclasses per batch costs more than the whole
     vectorized gate."""
 
-    __slots__ = ("_items",)
+    __slots__ = ("_items", "_n_set")
 
     def __init__(self, n: int):
         self._items = [None] * n
+        self._n_set = 0
 
     def __len__(self) -> int:
         return len(self._items)
@@ -53,11 +54,25 @@ class LazyResults:
             from banjax_tpu.matcher.api import ConsumeLineResult
 
             r = self._items[i] = ConsumeLineResult()
+            self._n_set += 1
         return r
 
     def __iter__(self):
         for k in range(len(self._items)):
             yield self[k]
+
+    def absorb(self, other: "LazyResults", row0: int) -> None:
+        """Copy `other`'s MATERIALIZED entries in at row offset `row0`
+        (the sharded-encode merge step); untouched rows stay lazy.  A
+        shard of clean traffic materializes nothing during the gate —
+        the counter makes that common case O(1) instead of a scan."""
+        if other._n_set == 0:
+            return
+        dst = self._items
+        for i, r in enumerate(other._items):
+            if r is not None:
+                dst[row0 + i] = r
+        self._n_set += other._n_set
 
 
 class LazyLine:
@@ -210,6 +225,90 @@ class ListWork(list):
     def take(self, idx) -> "ListWork":
         """Arbitrary-row subset (index array) — NativeWork.take parity."""
         return ListWork(list.__getitem__(self, int(i)) for i in idx)
+
+
+class CompositeWork:
+    """Strict line-order concatenation of per-shard work sets — the merge
+    half of the sharded encode pool (pipeline/scheduler.py).
+
+    Each part is a NativeWork/ListWork built over ONE contiguous row
+    shard of the admission batch; `offsets[j]` is the batch row its
+    shard started at.  Indices surfaced to consumers — the (orig_index,
+    line) pairs, and therefore results rows, window-event lines, and
+    replay order — are GLOBAL batch rows, so every downstream consumer
+    (slot scaffolding, the fused pipeline, replay, staleness take) is
+    agnostic to whether the encode ran sharded or single-threaded.
+
+    unique_ips() merges the per-shard first-appearance tables in shard
+    order, which IS global first-appearance order over the kept rows —
+    the property window-slot LRU assignment order (a parity surface)
+    depends on.  Positional subsets (slice/take) expect ascending
+    indices, which is what every caller passes (chunking, staleness
+    keep-masks, binary splits)."""
+
+    __slots__ = ("parts", "offsets", "_starts")
+
+    def __init__(self, parts: List, offsets: List[int]):
+        self.parts = parts        # non-empty work sets, shard order
+        self.offsets = offsets    # first batch row of each part's shard
+        self._starts = np.cumsum([0] + [len(w) for w in parts])
+
+    def __len__(self) -> int:
+        return int(self._starts[-1])
+
+    def __getitem__(self, k):
+        if isinstance(k, slice):
+            return self.take(
+                np.arange(*k.indices(len(self)), dtype=np.int64)
+            )
+        j = int(np.searchsorted(self._starts, k, side="right")) - 1
+        i, p = self.parts[j][k - int(self._starts[j])]
+        return self.offsets[j] + i, p
+
+    def __iter__(self):
+        for j, w in enumerate(self.parts):
+            off = self.offsets[j]
+            for i, p in w:
+                yield off + i, p
+
+    def take(self, idx) -> "CompositeWork | ListWork":
+        idx = np.asarray(idx, dtype=np.int64)
+        parts: List = []
+        offsets: List[int] = []
+        for j, w in enumerate(self.parts):
+            lo, hi = int(self._starts[j]), int(self._starts[j + 1])
+            sel = idx[(idx >= lo) & (idx < hi)] - lo
+            if sel.size:
+                parts.append(w.take(sel))
+                offsets.append(self.offsets[j])
+        if not parts:
+            return ListWork()
+        if len(parts) == 1 and offsets[0] == 0:
+            return parts[0]
+        return CompositeWork(parts, offsets)
+
+    def unique_ips(self) -> Tuple[List[str], np.ndarray]:
+        merged: Dict[str, int] = {}
+        strings: List[str] = []
+        invs = []
+        for w in self.parts:
+            ips_u, inv = w.unique_ips()
+            remap = np.empty(len(ips_u), dtype=np.int64)
+            for j, s in enumerate(ips_u):
+                g = merged.get(s)
+                if g is None:
+                    g = len(strings)
+                    merged[s] = g
+                    strings.append(s)
+                remap[j] = g
+            invs.append(remap[np.asarray(inv, dtype=np.int64)])
+        return strings, np.concatenate(invs)
+
+    def host_idx(self, host_row: Dict[str, int]) -> np.ndarray:
+        return np.concatenate([w.host_idx(host_row) for w in self.parts])
+
+    def ts_array(self) -> np.ndarray:
+        return np.concatenate([w.ts_array() for w in self.parts])
 
 
 def unique_spans(

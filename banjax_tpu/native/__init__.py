@@ -200,10 +200,14 @@ def parse_encode_batch(
     lines, byte_to_class: np.ndarray, max_len: int,
     now_unix: float, old_cutoff: float,
     scratch: Optional[ParseScratch] = None,
+    max_threads: Optional[int] = None,
 ) -> Optional[ParsedBatch]:
     """One native pass over a batch of log lines; None if the native
     library is unavailable (caller uses the Python path). With `scratch`,
-    outputs alias the caller-owned buffers (see ParseScratch)."""
+    outputs alias the caller-owned buffers (see ParseScratch).
+    `max_threads` caps the internal row-parallel fan-out — callers that
+    are themselves one shard of a worker pool (the pipeline's sharded
+    encode) pass 1 so the pool's parallelism isn't multiplied."""
     lib = _load()
     if lib is None:
         return None
@@ -253,7 +257,8 @@ def parse_encode_batch(
             P(s.cls_ids[i0:], i32p), P(s.lens[i0:], i32p),
         )
 
-    nt = min(_PARSE_THREADS, max(1, n // _MIN_ROWS_PER_THREAD))
+    limit = _PARSE_THREADS if max_threads is None else max(1, max_threads)
+    nt = min(limit, max(1, n // _MIN_ROWS_PER_THREAD))
     if nt <= 1:
         run_range(0, n)
     else:

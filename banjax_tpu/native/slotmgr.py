@@ -1,0 +1,233 @@
+"""ctypes shim for the native slot manager (native/slotmgr.c).
+
+`create(capacity)` returns a SlotManager, or None when no C compiler is
+available — callers (matcher/windows.py) keep the Python dict+LRU path,
+which doubles as the differential oracle (tests/unit/test_slotmgr.py).
+
+Same compile-on-first-use convention as banjax_tpu/native/__init__.py
+(cached .so keyed by platform + source mtime; BANJAX_NO_NATIVE disables).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sysconfig
+import tempfile
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "slotmgr.c")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _P(a: np.ndarray, t):
+    return a.ctypes.data_as(t)
+
+
+def _so_path() -> str:
+    plat = sysconfig.get_platform().replace("-", "_")
+    cache_dir = os.environ.get(
+        "BANJAX_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "banjax-native"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    src_mtime = int(os.stat(_SRC).st_mtime)
+    return os.path.join(cache_dir, f"slotmgr_{plat}_{src_mtime}.so")
+
+
+def _compile(so: str) -> bool:
+    for cc in (os.environ.get("CC"), "cc", "gcc", "clang"):
+        if not cc:
+            continue
+        cmd = [cc, "-O3", "-shared", "-fPIC", "-o", so, _SRC]
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            return True
+        log.debug("slotmgr compile with %s failed: %s", cc, r.stderr[-500:])
+    return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("BANJAX_NO_NATIVE"):
+            return None
+        so = _so_path()
+        if not os.path.exists(so) and not _compile(so):
+            log.info("no C compiler available; Python slot-manager path")
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            log.warning("could not load %s: %s", so, e)
+            return None
+        lib.sm_create.restype = ctypes.c_void_p
+        lib.sm_create.argtypes = [ctypes.c_int64]
+        lib.sm_destroy.restype = None
+        lib.sm_destroy.argtypes = [ctypes.c_void_p]
+        lib.sm_clear.restype = None
+        lib.sm_clear.argtypes = [ctypes.c_void_p]
+        lib.sm_assigned.restype = ctypes.c_int64
+        lib.sm_assigned.argtypes = [ctypes.c_void_p]
+        lib.sm_free_count.restype = ctypes.c_int64
+        lib.sm_free_count.argtypes = [ctypes.c_void_p]
+        lib.sm_grow.restype = ctypes.c_int64
+        lib.sm_grow.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sm_lookup_batch.restype = ctypes.c_int64
+        lib.sm_lookup_batch.argtypes = [
+            ctypes.c_void_p, _u8p, _i64p, _i64p, ctypes.c_int64,
+            ctypes.c_int64, _i64p, _i32p, _i64p,
+        ]
+        lib.sm_place_misses.restype = ctypes.c_int64
+        lib.sm_place_misses.argtypes = [
+            ctypes.c_void_p, _u8p, _i64p, _i64p, ctypes.c_int64,
+            _i32p, _i64p, _i32p, _i64p, ctypes.c_int64, _i64p, _i64p,
+        ]
+        _LIB = lib
+        log.info("native slotmgr loaded (%s)", so)
+        return _LIB
+
+
+def _encode_ips(ips: Sequence[str]) -> Tuple[bytes, np.ndarray, np.ndarray]:
+    """One blob + (offset, length) spans for a distinct-ip list.  The
+    common all-ASCII case is one join + one encode; byte lengths equal
+    char lengths so the per-ip work is a C-speed map(len)."""
+    n = len(ips)
+    joined = "".join(ips)
+    blob = joined.encode("utf-8", "surrogatepass")
+    if len(blob) == len(joined):
+        lens = np.fromiter(map(len, ips), dtype=np.int64, count=n)
+    else:  # non-ASCII ip strings (oracle inputs, not real traffic)
+        lens = np.fromiter(
+            (len(ip.encode("utf-8", "surrogatepass")) for ip in ips),
+            dtype=np.int64, count=n,
+        )
+    offs = np.zeros(n, dtype=np.int64)
+    if n > 1:
+        np.cumsum(lens[:-1], out=offs[1:])
+    return blob, offs, lens
+
+
+class SlotManager:
+    """One native ip->slot table.  All calls must be externally locked —
+    DeviceWindows holds its own lock around every use, exactly as it does
+    for the Python dict path."""
+
+    def __init__(self, lib: ctypes.CDLL, handle: int, capacity: int):
+        self._lib = lib
+        self._h = handle
+        self.capacity = capacity
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.sm_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # noqa: D105
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def clear(self) -> None:
+        self._lib.sm_clear(self._h)
+
+    def assigned(self) -> int:
+        return int(self._lib.sm_assigned(self._h))
+
+    def free_count(self) -> int:
+        return int(self._lib.sm_free_count(self._h))
+
+    def grow(self, new_capacity: int) -> None:
+        if self._lib.sm_grow(self._h, new_capacity) != 0:
+            raise MemoryError("slotmgr grow failed")
+        self.capacity = new_capacity
+
+    def lookup_batch(
+        self, ips: Sequence[str], batch_seq: int, last_used: np.ndarray
+    ):
+        """Pass 1 over a DISTINCT ip list: resolve hits (stamping their
+        recency with batch_seq) and collect misses.  Returns (slots
+        int32 [n] with -1 per miss, miss_idx int64 [m], ctx) — pass ctx
+        straight to place_misses.  The caller may grow the manager (and
+        its device arrays) between the two passes; the passes re-take
+        the array pointers, so reallocation in between is safe."""
+        n = len(ips)
+        slots = np.empty(n, dtype=np.int32)
+        if n == 0:
+            return slots, np.empty(0, np.int64), None
+        blob, offs, lens = _encode_ips(ips)
+        buf = np.frombuffer(blob, dtype=np.uint8) if blob else np.zeros(
+            1, dtype=np.uint8
+        )
+        miss_idx = np.empty(n, dtype=np.int64)
+        n_miss = int(self._lib.sm_lookup_batch(
+            self._h, _P(buf, _u8p), _P(offs, _i64p), _P(lens, _i64p), n,
+            batch_seq, _P(last_used, _i64p), _P(slots, _i32p),
+            _P(miss_idx, _i64p),
+        ))
+        return slots, miss_idx[:n_miss], (buf, offs, lens)
+
+    def place_misses(
+        self,
+        ctx,
+        slots: np.ndarray,
+        miss_idx: np.ndarray,
+        batch_seq: int,
+        pin_counts: np.ndarray,
+        last_used: np.ndarray,
+    ):
+        """Pass 2: place every miss, in ip order (free stack first, then
+        minimum-(last_used, slot) eviction).  Returns (placed_miss_idx,
+        evict_slots, ok).  ok=False is the refusal (every eviction
+        candidate pinned): placements made BEFORE the refusal persist,
+        and placed_miss_idx/evict_slots report exactly those — the
+        caller must bookkeep them (slot->ip mirror, pending device
+        evictions) before splitting the batch, as in the Python path."""
+        n_miss = len(miss_idx)
+        if n_miss == 0:
+            return np.empty(0, np.int64), np.empty(0, np.int64), True
+        buf, offs, lens = ctx
+        evict = np.empty(n_miss, dtype=np.int64)
+        counts = np.zeros(2, dtype=np.int64)
+        rc = int(self._lib.sm_place_misses(
+            self._h, _P(buf, _u8p), _P(offs, _i64p), _P(lens, _i64p),
+            batch_seq, _P(pin_counts, _i32p), _P(last_used, _i64p),
+            _P(slots, _i32p), _P(miss_idx, _i64p), n_miss,
+            _P(evict, _i64p), _P(counts, _i64p),
+        ))
+        return miss_idx[: int(counts[1])], evict[: int(counts[0])], rc == 0
+
+
+def create(capacity: int) -> Optional[SlotManager]:
+    """A SlotManager, or None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    h = lib.sm_create(capacity)
+    if not h:
+        return None
+    return SlotManager(lib, h, capacity)

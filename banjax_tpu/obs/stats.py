@@ -116,6 +116,11 @@ class MatcherStats:
             )
             self._last_evictions = evictions
             out["DeviceWindowsGrows"] = getattr(device_windows, "grow_count", 0)
+            # which slot-assignment path is live: the native C manager
+            # (native/slotmgr.c) or the Python dict+LRU fallback/oracle
+            out["SlotMgrNative"] = bool(
+                getattr(device_windows, "slotmgr_native", False)
+            )
             # shadowed IPs = all IPs with live counters (evicted included —
             # spill keeps them; see matcher/windows.py)
             out["DeviceWindowsShadowedIps"] = len(device_windows)
@@ -150,6 +155,16 @@ class MatcherStats:
                 )
                 out["PipelinedFusedFallbacks"] = getattr(
                     matcher, "pipelined_fused_fallbacks", 0
+                )
+                # depth-2 resolve-ahead drain: configured depth, and the
+                # EWMA wall time of event decode + replay that ran while
+                # the NEXT chunk's window program was already in flight —
+                # the d2h latency the overlap is hiding
+                out["DrainResolveAheadDepth"] = getattr(
+                    matcher, "_drain_resolve_depth", 1
+                )
+                out["DrainResolveOverlapMs"] = _r3(
+                    getattr(matcher, "drain_resolve_overlap_ms_ewma", None)
                 )
             # circuit breaker (resilience/breaker.py): the one place all
             # the ad-hoc fallback counters roll up for operators —
@@ -195,6 +210,14 @@ class PipelineStats:
         self._device_ring = [0.0] * _DEVICE_RING
         self._device_n = 0
         self._device_p99_ewma: Optional[float] = None
+        # sharded encode-worker pool (scheduler._begin_state): interval
+        # max of the slowest shard's wall time (the merge barrier waits
+        # on it), EWMA utilization = sum(shard wall) / (workers * fan-out
+        # wall) — 1.0 means perfectly balanced shards, low values mean
+        # the fan-out is overhead-bound and encode_workers is too high
+        self.encode_sharded_batches = 0
+        self._encode_shard_ms_max = 0.0  # reset each snapshot
+        self._encode_util_ewma: Optional[float] = None
 
     def note_admitted(self, n: int) -> None:
         with self._lock:
@@ -226,6 +249,22 @@ class PipelineStats:
         with self._lock:
             self.command_items += n
             self.command_batches += 1
+
+    def note_encode_shards(
+        self, max_ms: float, utilization: float, n_shards: int
+    ) -> None:
+        """One sharded encode fan-out's timing (scheduler._begin_state)."""
+        del n_shards  # recorded for signature clarity; keys cover max/util
+        with self._lock:
+            self.encode_sharded_batches += 1
+            if max_ms > self._encode_shard_ms_max:
+                self._encode_shard_ms_max = max_ms
+            u = min(1.0, max(0.0, utilization))
+            self._encode_util_ewma = (
+                u if self._encode_util_ewma is None
+                else self._encode_util_ewma
+                + 0.3 * (u - self._encode_util_ewma)
+            )
 
     def note_probe(self, ok: bool) -> None:
         with self._lock:
@@ -264,7 +303,15 @@ class PipelineStats:
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             p99 = self._device_p99_ewma
+            shard_max = self._encode_shard_ms_max
+            self._encode_shard_ms_max = 0.0  # interval max, like a gauge
             return {
+                "EncodeShardedBatches": self.encode_sharded_batches,
+                "EncodeShardMsMax": round(shard_max, 3),
+                "EncodeWorkerUtilization": (
+                    None if self._encode_util_ewma is None
+                    else round(self._encode_util_ewma, 3)
+                ),
                 "PipelineAdmittedLines": self.admitted_lines,
                 "PipelineProcessedLines": self.processed_lines,
                 "PipelineShedLines": self.shed_lines,

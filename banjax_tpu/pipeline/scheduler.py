@@ -74,6 +74,7 @@ the breaker before the next traffic burst.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -86,6 +87,19 @@ from banjax_tpu.resilience import failpoints
 from banjax_tpu.resilience.breaker import OPEN
 
 log = logging.getLogger(__name__)
+
+# a shard below this many rows costs more in fan-out/merge overhead than
+# the parallel parse saves; batches smaller than 2x this stay single-thread
+_MIN_SHARD_LINES = 2048
+
+
+def resolve_encode_workers(v: int) -> int:
+    """-1 = auto: min(4, cores), but 0 (single-thread, no pool) on a
+    single-core host where a worker adds handoff latency for nothing."""
+    if v >= 0:
+        return v
+    cores = os.cpu_count() or 1
+    return min(4, cores) if cores > 1 else 0
 
 
 class _Batch:
@@ -127,6 +141,8 @@ class PipelineScheduler:
         min_batch: int = 64,
         max_batch: int = 16384,
         probe_seconds: float = 0.0,
+        encode_workers: int = 0,
+        command_take_max: int = 1024,
         health=None,
         on_results: Optional[Callable[[List[str], Optional[list]], None]] = None,
         now_fn: Callable[[], float] = time.time,
@@ -140,11 +156,19 @@ class PipelineScheduler:
         self.buffer_lines = buffer_lines
         self.max_block_s = max(0.0, max_block_ms) / 1e3
         self.probe_seconds = probe_seconds
+        # sharded encode-worker pool (0 = the single-thread encode path):
+        # the encode stage splits each admission batch into row shards
+        # fanned across this many threads — the native parse and the
+        # columnar gate are GIL-free, so the host path scales with cores
+        # instead of capping at one Python thread
+        self.encode_workers = max(0, int(encode_workers))
+        self._encode_pool = None  # created at start(), joined at stop()
         self._health = health
         self._on_results = on_results
         self._now_fn = now_fn
         self._sizer = AdaptiveBatchSizer(
-            latency_budget_ms, min_batch=min_batch, max_batch=max_batch
+            latency_budget_ms, min_batch=min_batch, max_batch=max_batch,
+            command_max=command_take_max,
         )
         self.stats = PipelineStats()
         self._buf: deque = deque()
@@ -169,6 +193,12 @@ class PipelineScheduler:
             max_block_ms=getattr(config, "pipeline_max_block_ms", 250.0),
             max_batch=max(64, getattr(config, "matcher_batch_lines", 16384)),
             probe_seconds=getattr(config, "matcher_probe_seconds", 0.0),
+            encode_workers=resolve_encode_workers(
+                getattr(config, "encode_workers", -1)
+            ),
+            command_take_max=getattr(
+                config, "pipeline_command_take_max", 1024
+            ),
             health=health,
             on_results=on_results,
         )
@@ -176,6 +206,13 @@ class PipelineScheduler:
     # ---- lifecycle ----
 
     def start(self) -> None:
+        if self.encode_workers > 0 and self._encode_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._encode_pool = ThreadPoolExecutor(
+                max_workers=self.encode_workers,
+                thread_name_prefix="pipeline-encode-worker",
+            )
         loops = [
             ("pipeline-encode", self._encode_loop),
             ("pipeline-device", self._device_loop),
@@ -200,6 +237,10 @@ class PipelineScheduler:
         for t in self._threads:
             t.join(max(0.1, deadline - time.monotonic()))
         self._threads = []
+        if self._encode_pool is not None:
+            # after the stage threads joined no new shard work can arrive
+            self._encode_pool.shutdown(wait=True)
+            self._encode_pool = None
 
     def flush(self, timeout: float = 60.0) -> bool:
         """Block until every admitted line has drained (tests/bench)."""
@@ -294,10 +335,21 @@ class PipelineScheduler:
                     # the sizer's trickle rule ignores them.  A batch is
                     # homogeneous: a run of log lines OR a run of command
                     # messages, split at the kind boundary so admission
-                    # order is preserved exactly.
-                    take = min(len(self._buf), self._sizer.target())
+                    # order is preserved exactly.  Command batches have
+                    # their OWN take bound (sizer.command_target): they
+                    # carry no device timing for AIMD, and an unbounded
+                    # take would let a Kafka command flood monopolize the
+                    # drain thread in one giant dispatch loop, starving
+                    # line batching.
+                    is_cmd = bool(self._buf) and isinstance(
+                        self._buf[0], _Command
+                    )
+                    take = min(
+                        len(self._buf),
+                        self._sizer.command_target() if is_cmd
+                        else self._sizer.target(),
+                    )
                     lines = []
-                    is_cmd = self._buf and isinstance(self._buf[0], _Command)
                     while (
                         len(lines) < take and self._buf
                         and isinstance(self._buf[0], _Command) == is_cmd
@@ -336,7 +388,7 @@ class PipelineScheduler:
                 )
             try:
                 failpoints.check("pipeline.encode")
-                batch.state = matcher.pipeline_begin(lines, self._now_fn())
+                batch.state = self._begin_state(matcher, lines)
             except Exception:  # noqa: BLE001 — encode failure → generic drain, no loss
                 log.exception(
                     "pipeline encode stage failed; batch drains generically"
@@ -344,6 +396,56 @@ class PipelineScheduler:
                 batch.state = None
         batch.t_encode_ms = (time.perf_counter() - t0) * 1e3
         return batch
+
+    def _begin_state(self, matcher, lines: List[str]):
+        """pipeline_begin, sharded across the encode-worker pool when the
+        batch is big enough to pay for the fan-out.  Shard boundaries are
+        contiguous row ranges; the matcher's merge reassembles columnar
+        arrays and unique-IP tables in strict line order, so downstream
+        output is byte-identical to the single-thread path.  A failing
+        shard (worker death, the pipeline.encode_shard failpoint) fails
+        only THIS batch — the exception propagates to _encode_batch's
+        generic-drain fallback and the pool itself survives."""
+        now = self._now_fn()
+        pool = self._encode_pool
+        n = len(lines)
+        n_shards = 0
+        if (
+            pool is not None
+            and hasattr(matcher, "encode_shard")
+            and hasattr(matcher, "pipeline_begin_from_shards")
+        ):
+            n_shards = min(self.encode_workers, n // _MIN_SHARD_LINES)
+        if n_shards < 2:
+            return matcher.pipeline_begin(lines, now)
+        bounds = [n * k // n_shards for k in range(n_shards + 1)]
+        shard_ms = [0.0] * n_shards
+
+        def run(k: int):
+            t = time.perf_counter()
+            failpoints.check("pipeline.encode_shard")
+            out = matcher.encode_shard(lines[bounds[k] : bounds[k + 1]], now)
+            shard_ms[k] = (time.perf_counter() - t) * 1e3
+            return out
+
+        t_fan = time.perf_counter()
+        futs = [pool.submit(run, k) for k in range(n_shards)]
+        shards = []
+        err = None
+        for k, f in enumerate(futs):
+            try:
+                shards.append((bounds[k], f.result()))
+            except Exception as e:  # noqa: BLE001 — await EVERY future before raising
+                err = err or e
+        if err is not None:
+            raise err
+        wall_ms = (time.perf_counter() - t_fan) * 1e3
+        self.stats.note_encode_shards(
+            max(shard_ms),
+            sum(shard_ms) / max(1e-9, wall_ms * n_shards),
+            n_shards,
+        )
+        return matcher.pipeline_begin_from_shards(lines, now, shards)
 
     # ---- device stage ----
 
@@ -570,4 +672,5 @@ class PipelineScheduler:
             out["PipelineBufferedLines"] = len(self._buf)
             out["PipelineInflightBatches"] = self._inflight
         out["PipelineRingSize"] = self.ring_size
+        out["EncodeWorkers"] = self.encode_workers
         return out

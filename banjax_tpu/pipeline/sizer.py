@@ -65,6 +65,7 @@ class AdaptiveBatchSizer:
         start_batch: int = 1024,
         alpha: float = 0.3,
         settle: int = 2,
+        command_max: int = 1024,
     ):
         if budget_ms <= 0:
             raise ValueError(f"budget_ms must be positive, got {budget_ms}")
@@ -72,7 +73,12 @@ class AdaptiveBatchSizer:
             raise ValueError(
                 f"bad batch bounds [{min_batch}, {max_batch}]"
             )
+        if command_max < 1:
+            raise ValueError(
+                f"command_max must be >= 1, got {command_max}"
+            )
         self.budget_ms = budget_ms
+        self.command_max = command_max
         self.min_batch = _pow2_at_most(min_batch)
         self.max_batch = _pow2_at_most(max_batch)
         self._alpha = alpha
@@ -101,6 +107,16 @@ class AdaptiveBatchSizer:
         """Current batch-size cap for the encode stage."""
         with self._lock:
             return self._bucket
+
+    def command_target(self) -> int:
+        """Take-size bound for COMMAND batches (ROADMAP PR 3 follow-up).
+        Commands bypass the device, so they produce no stage timings for
+        AIMD to learn from; instead of riding the adaptive line bucket
+        (which a command flood would stretch to max_batch) they get a
+        fixed cap, chopping a Kafka command flood into bounded batches
+        that interleave with line batches at the admission-order kind
+        boundary rather than starving line batching."""
+        return self.command_max
 
     def observe(self, n_lines: int, stage_ms: Dict[str, float]) -> None:
         """One drained batch's per-stage wall times (ms).  Batches far
@@ -171,7 +187,10 @@ class AdaptiveBatchSizer:
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
-            out: Dict[str, object] = {"PipelineBatchTarget": self._bucket}
+            out: Dict[str, object] = {
+                "PipelineBatchTarget": self._bucket,
+                "PipelineCommandBatchTarget": self.command_max,
+            }
             for s in _STAGES:
                 v = self.stage_ewma_ms.get(s)
                 out[f"PipelineStage{s.capitalize()}EwmaMs"] = (

@@ -82,6 +82,12 @@ timeout 900 python bench.py --fused-pipeline || true
 BENCH_STREAM_DEVICE_WINDOWS=1 timeout 900 python bench.py --pipeline || true
 timeout 600 python __graft_entry__.py || true
 
+# 4c. host-parallel A/B (sharded encode workers + native slot manager):
+# banks the multi-core chip-host row into BENCH_host_parallel.json next
+# to the 1-core CI row (rows are keyed by core count, so neither
+# clobbers the other)
+timeout 900 python bench.py --host-parallel || true
+
 # 5. re-bank the two headline sections (tpu rows overwrite tpu rows,
 # newest wins; a re-run with warm compile caches is usually the cleaner
 # number)

@@ -335,6 +335,11 @@ def test_auto_grow_absorbs_distinct_ip_pressure():
     dw._pin_counts = np.zeros(2, dtype=np.int32)
     dw._last_used = np.zeros(2, dtype=np.int64)
     dw._state = dw._fresh_state()
+    if dw._sm is not None:  # rebuild the native manager at the shrunk size
+        from banjax_tpu.native import slotmgr as _slotmgr
+
+        dw._sm.close()
+        dw._sm = _slotmgr.create(2)
     one = np.ones((1, 1), dtype=np.uint8)
     active = np.ones((1, 1), dtype=bool)
     base = 1_700_000_000 * NS
