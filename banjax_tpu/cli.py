@@ -40,6 +40,7 @@ from banjax_tpu.ingest.kafka_io import KafkaReader, KafkaWriter
 from banjax_tpu.ingest.reports import report_status_message
 from banjax_tpu.ingest.tailer import LogTailer
 from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from banjax_tpu.obs import trace
 from banjax_tpu.obs.metrics import MetricsReporter
 from banjax_tpu.resilience import failpoints
 from banjax_tpu.resilience.health import HealthRegistry
@@ -101,6 +102,16 @@ class BanjaxApp:
         self.health = HealthRegistry()
         if getattr(config, "failpoints", ""):
             failpoints.arm_from_spec(config.failpoints)
+
+        # pipeline span tracing (obs/trace.py): off by default — the
+        # disabled tracer's no-op fast path keeps the hot path at ≤1%
+        # overhead (bench.py --trace-overhead); /debug/trace dumps the
+        # ring as Perfetto-loadable Chrome trace JSON when enabled
+        trace.configure(
+            enabled=getattr(config, "trace_enabled", False),
+            ring_size=getattr(config, "trace_ring_size", 4096),
+            jax_annotations=getattr(config, "trace_jax_annotations", False),
+        )
 
         self.regex_states = RegexRateLimitStates()
         self._supervisor = None  # multi-worker serving (httpapi/workers.py)
@@ -308,6 +319,11 @@ class BanjaxApp:
             gin_log_file=self._gin_log_file,
             server_log_file=self._server_log_file,
             health=self.health,
+            # /metrics exposition sources (non-destructive peek() reads —
+            # the 29 s line's interval windows are never stolen)
+            matcher_getter=lambda: self._matcher,
+            pipeline_getter=lambda: self.pipeline,
+            supervisor_getter=lambda: self._supervisor,
         )
 
     async def _serve(self, install_signal_handlers: bool) -> None:

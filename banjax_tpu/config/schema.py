@@ -263,6 +263,29 @@ class Config:
     # instead of riding the (much larger) adaptive line bucket and
     # starving line batching.
     pipeline_command_take_max: int = 1024
+    # --- observability (banjax_tpu/obs/trace.py, obs/exposition.py) ---
+    # ring-buffered pipeline span recorder: each admission batch gets a
+    # trace id carried through encode/submit/collect/drain; /debug/trace
+    # dumps the ring as Chrome trace_event JSON (Perfetto-loadable).
+    # Off by default — the disabled fast path is a single attribute
+    # check per call site (bench.py --trace-overhead banks the measured
+    # on/off delta).
+    trace_enabled: bool = False
+    # span slots in the ring (oldest overwritten); ~120 bytes/slot
+    trace_ring_size: int = 4096
+    # also enter jax.profiler.TraceAnnotation per span (and a
+    # StepTraceAnnotation per batch submit) so host spans line up with
+    # the XLA/TPU device timeline when a profiler session is active
+    trace_jax_annotations: bool = False
+    # bearer token for the admin surface (/healthz, /metrics,
+    # /debug/trace).  Enforced (constant-time compare) only when the
+    # HTTP listener binds a non-loopback address; loopback stays open
+    # by default like the reference's 127.0.0.1:8081 surface.
+    admin_token: str = ""
+    # listener bind address; empty = the reference's hard-coded
+    # 127.0.0.1.  Binding non-loopback without admin_token logs a
+    # warning (the whole admin surface would be open to the network).
+    http_listen_host: str = ""
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -306,6 +329,9 @@ _SCALAR_KEYS = {
     "pipeline_fused": bool, "pipeline_kafka": bool,
     "encode_workers": int, "slotmgr_native": bool,
     "drain_resolve_depth": int, "pipeline_command_take_max": int,
+    "trace_enabled": bool, "trace_ring_size": int,
+    "trace_jax_annotations": bool, "admin_token": str,
+    "http_listen_host": str,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -453,6 +479,11 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
         raise ValueError(
             "config key pipeline_command_take_max: expected >= 1, got "
             f"{cfg.pipeline_command_take_max}"
+        )
+    if cfg.trace_ring_size < 1:
+        raise ValueError(
+            "config key trace_ring_size: expected >= 1, got "
+            f"{cfg.trace_ring_size}"
         )
 
     return cfg

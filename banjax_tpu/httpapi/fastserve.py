@@ -348,11 +348,13 @@ class FastPathServer:
     """Builds native handlers from ServerDeps; owns the upstream proxy."""
 
     def __init__(self, deps, proxy_sock: str,
-                 coalesced_gin=None, coalesced_server=None):
+                 coalesced_gin=None, coalesced_server=None,
+                 listen_host: str = "127.0.0.1"):
         self.deps = deps
         self.proxy_sock = proxy_sock
         self.gin_log = coalesced_gin
         self.server_log = coalesced_server
+        self.listen_host = listen_host  # admin-surface auth gate input
         config0 = deps.config_holder.get()
         self.standalone = config0.standalone_testing
 
@@ -414,12 +416,27 @@ class FastPathServer:
             resp = Response(status=200, body=body,
                             content_type="application/json; charset=utf-8")
         elif path == "/healthz":
-            snap = self.deps.health.snapshot()
-            resp = Response(
-                status=503 if snap["status"] == "failed" else 200,
-                body=json.dumps(snap).encode(),
-                content_type="application/json; charset=utf-8",
-            )
+            # same admin gate as the aiohttp layout (server.admin_auth_ok):
+            # bearer-token required when the listener binds non-loopback
+            from banjax_tpu.httpapi.server import admin_auth_ok
+
+            if not admin_auth_ok(
+                self.deps.config_holder.get(), self.listen_host,
+                req.header("authorization"),
+            ):
+                resp = Response(
+                    status=401,
+                    body=b'{"error": "unauthorized"}',
+                    content_type="application/json; charset=utf-8",
+                    headers={"WWW-Authenticate": "Bearer"},
+                )
+            else:
+                snap = self.deps.health.snapshot()
+                resp = Response(
+                    status=503 if snap["status"] == "failed" else 200,
+                    body=json.dumps(snap).encode(),
+                    content_type="application/json; charset=utf-8",
+                )
         elif path == "/favicon.ico":
             # the aiohttp route uses web.Response(text="") — charset added
             resp = Response(status=200, body=b"",
@@ -554,7 +571,8 @@ async def start_fast_server(deps, proxy_sock: str, host: str, port: int,
                             reuse_port: bool = False,
                             coalesced_gin=None, coalesced_server=None):
     """Bind the fast-path protocol server; returns the asyncio Server."""
-    fps = FastPathServer(deps, proxy_sock, coalesced_gin, coalesced_server)
+    fps = FastPathServer(deps, proxy_sock, coalesced_gin, coalesced_server,
+                         listen_host=host)
     loop = asyncio.get_running_loop()
     server = await loop.create_server(
         lambda: FastHttpProtocol(fps), host, port,

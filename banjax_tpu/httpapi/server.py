@@ -21,12 +21,13 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hmac
 import json
 import logging
 import os
 import time
 import traceback
-from typing import Optional, TextIO
+from typing import Callable, Optional, TextIO
 
 from aiohttp import web
 
@@ -55,6 +56,32 @@ log = logging.getLogger(__name__)
 LISTEN_HOST = "127.0.0.1"
 LISTEN_PORT = 8081  # http_server.go:42 (XXX config — kept identical)
 
+_LOOPBACK_HOSTS = {"", "127.0.0.1", "::1", "localhost"}
+
+
+def is_loopback_host(host: str) -> bool:
+    return (host or "").strip("[]") in _LOOPBACK_HOSTS or (
+        host or ""
+    ).startswith("127.")
+
+
+def admin_auth_ok(config, listen_host: str, authorization: str) -> bool:
+    """Gate for the admin surface (/healthz, /metrics, /debug/trace).
+
+    Open on a loopback listener (the reference's 127.0.0.1:8081 posture —
+    local operators and sidecar scrapers need no secret) or when no
+    `admin_token` is configured (run_http_server logged the warning at
+    bind time).  Otherwise the request must carry `Authorization:
+    Bearer <token>`; comparison is constant-time so the token can't be
+    recovered byte-by-byte from response timing."""
+    token = getattr(config, "admin_token", "") or ""
+    if not token or is_loopback_host(listen_host):
+        return True
+    provided = authorization or ""
+    if provided.startswith("Bearer "):
+        provided = provided[len("Bearer "):]
+    return hmac.compare_digest(token.encode(), provided.encode())
+
 
 @dataclasses.dataclass
 class ServerDeps:
@@ -68,6 +95,11 @@ class ServerDeps:
     gin_log_file: Optional[TextIO] = None  # the JSON access log
     server_log_file: Optional[TextIO] = None  # standalone: fake nginx log
     health: Optional[object] = None  # resilience.health.HealthRegistry
+    # /metrics exposition sources (getters, not objects: SIGHUP reload
+    # swaps the matcher, and the supervisor appears after spawn)
+    matcher_getter: Optional[Callable[[], object]] = None
+    pipeline_getter: Optional[Callable[[], object]] = None
+    supervisor_getter: Optional[Callable[[], object]] = None
 
 
 _STANDALONE_KEY = "banjax_standalone_hdrs"
@@ -158,7 +190,8 @@ def _to_web_response(resp: Response) -> web.Response:
 
 
 def build_app(deps: ServerDeps,
-              worker_proxy_sock: Optional[str] = None) -> web.Application:
+              worker_proxy_sock: Optional[str] = None,
+              listen_host: str = LISTEN_HOST) -> web.Application:
     """Build the application.  With `worker_proxy_sock` set (multi-worker
     mode, httpapi/workers.py) the primary-owned cold routes are registered
     as reverse proxies to the primary's unix HTTP socket instead of local
@@ -427,7 +460,22 @@ def build_app(deps: ServerDeps,
             }
         )
 
+    def _admin_denied(request: web.Request) -> Optional[web.Response]:
+        """None when the admin request may proceed; a 401 otherwise.
+        Evaluated per request (not at build time) so a SIGHUP'd token
+        takes effect without a listener restart."""
+        if admin_auth_ok(deps.config_holder.get(), listen_host,
+                         request.headers.get("Authorization", "")):
+            return None
+        return web.json_response(
+            {"error": "unauthorized"}, status=401,
+            headers={"WWW-Authenticate": "Bearer"},
+        )
+
     async def healthz(request: web.Request) -> web.Response:
+        denied = _admin_denied(request)
+        if denied is not None:
+            return denied
         # the component health aggregate (resilience/health.py): 200 while
         # serving is possible (HEALTHY or DEGRADED — degraded modes still
         # answer traffic), 503 only when a component has FAILED
@@ -437,12 +485,52 @@ def build_app(deps: ServerDeps,
         status = 503 if snap["status"] == "failed" else 200
         return web.json_response(snap, status=status)
 
+    async def metrics_route(request: web.Request) -> web.Response:
+        denied = _admin_denied(request)
+        if denied is not None:
+            return denied
+        from banjax_tpu.obs.exposition import render_prometheus
+
+        text = render_prometheus(
+            deps.dynamic_lists,
+            deps.regex_states,
+            deps.failed_challenge_states,
+            matcher=deps.matcher_getter() if deps.matcher_getter else None,
+            pipeline=deps.pipeline_getter() if deps.pipeline_getter else None,
+            health=deps.health,
+            supervisor=(
+                deps.supervisor_getter() if deps.supervisor_getter else None
+            ),
+        )
+        return web.Response(
+            text=text,
+            content_type="text/plain",
+            charset="utf-8",
+            headers={"X-Prometheus-Exposition-Version": "0.0.4"},
+        )
+
+    async def debug_trace_route(request: web.Request) -> web.Response:
+        denied = _admin_denied(request)
+        if denied is not None:
+            return denied
+        from banjax_tpu.obs import trace as trace_mod
+
+        tracer = trace_mod.get_tracer()
+        payload = tracer.export_chrome()
+        payload["otherData"]["enabled"] = tracer.enabled
+        if request.query.get("clear") in ("1", "true"):
+            tracer.clear()
+        return web.json_response(payload)
+
     app.router.add_route("*", "/auth_request", auth_request)
     app.router.add_get("/info", info)
     if worker_proxy_sock is None:
-        # /healthz is primary-owned (the registry lives there); workers
-        # reverse-proxy it like the other cold routes
+        # /healthz, /metrics and /debug/trace are primary-owned (the
+        # registries live there); workers reverse-proxy them like the
+        # other cold routes
         app.router.add_get("/healthz", healthz)
+        app.router.add_get("/metrics", metrics_route)
+        app.router.add_get("/debug/trace", debug_trace_route)
         app.router.add_get("/decision_lists", decision_lists_route)
         app.router.add_get("/rate_limit_states", rate_limit_states_route)
         app.router.add_get("/is_banned", is_banned)
@@ -592,17 +680,30 @@ async def run_http_server(
 
     config0 = deps.config_holder.get()
     fast = bool(getattr(config0, "http_fast_path", True))
+    # bind address: empty config = the reference's hard-coded loopback.
+    # Non-loopback without an admin token leaves /healthz, /metrics and
+    # /debug/trace open to the network — allowed, but loudly.
+    listen_host = getattr(config0, "http_listen_host", "") or LISTEN_HOST
+    if not is_loopback_host(listen_host) and not getattr(
+        config0, "admin_token", ""
+    ):
+        log.warning(
+            "http listener binds non-loopback %s with no admin_token: the "
+            "admin surface (/healthz /metrics /debug/trace) is open to the "
+            "network", listen_host,
+        )
 
     if not fast:
-        app = build_app(deps, worker_proxy_sock=worker_proxy_sock)
+        app = build_app(deps, worker_proxy_sock=worker_proxy_sock,
+                        listen_host=listen_host)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
-        site = web.TCPSite(runner, LISTEN_HOST, LISTEN_PORT,
+        site = web.TCPSite(runner, listen_host, LISTEN_PORT,
                            reuse_port=reuse_port)
         await site.start()
         if unix_path is not None:
             await web.UnixSite(runner, unix_path).start()
-        log.info("http server listening on %s:%s", LISTEN_HOST, LISTEN_PORT)
+        log.info("http server listening on %s:%s", listen_host, LISTEN_PORT)
         return ServerHandle(runner=runner)
 
     gin_log = (
@@ -620,12 +721,12 @@ async def run_http_server(
         # worker: the fast server IS the whole process surface; cold
         # routes raw-proxy to the primary's unix socket
         fast_server = await start_fast_server(
-            deps, worker_proxy_sock, LISTEN_HOST, LISTEN_PORT,
+            deps, worker_proxy_sock, listen_host, LISTEN_PORT,
             reuse_port=True, coalesced_gin=gin_log,
             coalesced_server=server_log,
         )
         log.info("fast http worker listening on %s:%s",
-                 LISTEN_HOST, LISTEN_PORT)
+                 listen_host, LISTEN_PORT)
         return ServerHandle(fast_server=fast_server, fast_logs=fast_logs)
 
     # primary / single process: full aiohttp app on a unix socket (the
@@ -637,15 +738,15 @@ async def run_http_server(
 
         tmpdir = tempfile.mkdtemp(prefix="banjax-http-")
         unix_path = os.path.join(tmpdir, "app.sock")
-    app = build_app(deps)
+    app = build_app(deps, listen_host=listen_host)
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
     await web.UnixSite(runner, unix_path).start()
     fast_server = await start_fast_server(
-        deps, unix_path, LISTEN_HOST, LISTEN_PORT, reuse_port=reuse_port,
+        deps, unix_path, listen_host, LISTEN_PORT, reuse_port=reuse_port,
         coalesced_gin=gin_log, coalesced_server=server_log,
     )
     log.info("fast http server on %s:%s (aiohttp upstream %s)",
-             LISTEN_HOST, LISTEN_PORT, unix_path)
+             listen_host, LISTEN_PORT, unix_path)
     return ServerHandle(runner=runner, fast_server=fast_server,
                         tmpdir=tmpdir, fast_logs=fast_logs)

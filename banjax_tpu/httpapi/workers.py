@@ -61,6 +61,10 @@ COLD_ROUTES = (
     "/banned",
     "/unban",
     "/healthz",
+    # observability surface: the metrics registries and the trace ring
+    # live in the primary (the pipeline/matcher run there)
+    "/metrics",
+    "/debug/trace",
 )
 
 

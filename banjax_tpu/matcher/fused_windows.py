@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from banjax_tpu.matcher import windows as W
+from banjax_tpu.obs import trace
 from banjax_tpu.matcher.prefilter import FusedPrefilter
 from banjax_tpu.matcher.windows import DeviceWindows, WindowEvent
 from banjax_tpu.decisions.rate_limit import RateLimitMatchType
@@ -324,8 +325,15 @@ class FusedWindowsPipeline:
 
     def _wait_turn(self, p: _Pend, attr: str) -> None:
         with self._cv:
-            while getattr(self, attr) != p.seq:
-                self._cv.wait()
+            if getattr(self, attr) == p.seq:
+                return
+        # the drain thread blocking on an out-of-order turn is exactly
+        # the stall a trace must show; the fast path above stays lock+
+        # check only (the span records nothing when tracing is off)
+        with trace.span("turn-wait", args={"seq": p.seq, "gate": attr}):
+            with self._cv:
+                while getattr(self, attr) != p.seq:
+                    self._cv.wait()
 
     def _sweep_locked(self, attr: str, v: int) -> None:
         dead = self._dead[attr]

@@ -50,6 +50,7 @@ from banjax_tpu.matcher.workset import (
     unique_spans,
 )
 from banjax_tpu.matcher.rulec import compile_rules
+from banjax_tpu.obs import trace
 from banjax_tpu.resilience import failpoints
 from banjax_tpu.resilience.breaker import CLOSED, CircuitBreaker
 from banjax_tpu.resilience.health import HealthRegistry, HealthStatus
@@ -84,6 +85,11 @@ class TpuMatcher(Matcher):
             recovery_seconds=getattr(config, "breaker_recovery_seconds", 30.0),
             window_size=getattr(config, "breaker_window_size", 0),
             name="matcher-device",
+            # breaker trips land in the trace ring as instant events so a
+            # Perfetto view shows WHEN degraded mode started relative to
+            # the batch spans around it
+            on_trip=lambda name: trace.instant("breaker-trip",
+                                               {"breaker": name}),
         )
         self._latency_budget_s = (
             getattr(config, "matcher_latency_budget_ms", 0.0) or 0.0
@@ -746,11 +752,14 @@ class TpuMatcher(Matcher):
         entries = []
         try:
             for s in range(0, len(work), self._max_batch):
-                e = self._submit_pipeline_chunk(
-                    work[s : s + self._max_batch],
-                    cls_ids[s : s + self._max_batch],
-                    lens[s : s + self._max_batch],
-                )
+                # child of the scheduler's ambient `submit` span: one
+                # program-A (stateless match) dispatch per chunk
+                with trace.span("program-a", args={"row0": s}):
+                    e = self._submit_pipeline_chunk(
+                        work[s : s + self._max_batch],
+                        cls_ids[s : s + self._max_batch],
+                        lens[s : s + self._max_batch],
+                    )
                 if e is None:
                     # more distinct IPs than free+unpinned slots (in-flight
                     # batches hold pins until their drains): classic path
@@ -874,22 +883,29 @@ class TpuMatcher(Matcher):
         def collect_replay(e, overlapped: bool) -> None:
             pend = e["pend"]
             t0 = time.perf_counter()
-            try:
-                res = fw.collect(pend)
-                self._replay_window_events(
-                    e["work"], None, (res.matched_pairs, res.always_bits),
-                    res.events, results, live_rows=e["live"],
-                )
-                self.pipelined_fused_chunks += 1
-            except Exception:  # noqa: BLE001 — collect released pins/turns in finally
-                log.exception(
-                    "pipelined fused event collect failed; chunk lines "
-                    "marked error"
-                )
-                self._mark_chunk_error(e, e["chunk_stale"], results)
-                self.note_device_outcome(0.0, ok=False)
-            finally:
-                self.stats.note_xfer(pend.h2d_bytes, pend.d2h_bytes)
+            # child of the scheduler's ambient `drain` span: event pull +
+            # decode + Banner replay for one committed chunk — the work
+            # the resolve-ahead hides behind the next chunk's program B
+            with trace.span("effector-replay",
+                            args={"row0": e["row0"],
+                                  "overlapped": overlapped}):
+                try:
+                    res = fw.collect(pend)
+                    self._replay_window_events(
+                        e["work"], None,
+                        (res.matched_pairs, res.always_bits),
+                        res.events, results, live_rows=e["live"],
+                    )
+                    self.pipelined_fused_chunks += 1
+                except Exception:  # noqa: BLE001 — collect released pins/turns in finally
+                    log.exception(
+                        "pipelined fused event collect failed; chunk lines "
+                        "marked error"
+                    )
+                    self._mark_chunk_error(e, e["chunk_stale"], results)
+                    self.note_device_outcome(0.0, ok=False)
+                finally:
+                    self.stats.note_xfer(pend.h2d_bytes, pend.d2h_bytes)
             if overlapped:
                 # the d2h-overlap witness: this collect+replay wall time
                 # ran while a later chunk's B was in flight
@@ -920,11 +936,17 @@ class TpuMatcher(Matcher):
             e["live"] = live
             try:
                 failpoints.check("matcher.resolve")
-                fw.resolve(pend, live=live)
+                # program B (window commit) dispatch for this chunk, in
+                # admission order — child of the ambient `drain` span
+                with trace.span("program-b",
+                                args={"row0": s,
+                                      "masked": live is not None}):
+                    fw.resolve(pend, live=live)
             except PipelineOverflow as ov:
                 # earlier chunks' effects must fire before this chunk's
                 # classic replay: drain the resolve-ahead window first
                 drain_pending()
+                trace.instant("fused-overflow-fallback", {"row0": s})
                 self.pipelined_fused_fallbacks += 1
                 try:
                     self._pipeline_fallback_entry(e, ov, results, live=live)

@@ -9,6 +9,12 @@ The reference's five keys keep their exact names and meaning; the TPU
 subsystem's production counters (matcher lines/sec, batch latency p50/p99,
 device-windows occupancy/evictions — obs/stats.py) are ADDITIVE keys on the
 same line, present when a matcher is wired in.
+
+Every key this line can emit is declared in obs/registry.py — the same
+registry /metrics (obs/exposition.py) renders from — so the two surfaces
+cannot drift apart silently (tests/unit/test_exposition.py).  The line
+keeps the resetting interval windows (snapshot()); /metrics reads only
+the non-destructive peek() views.
 """
 
 from __future__ import annotations

@@ -1,0 +1,330 @@
+"""The single exposition-schema registry.
+
+Every key the 29-second metrics line can emit and every Prometheus
+family `/metrics` can expose is declared HERE — name, type, help — so a
+renamed counter fails CI (tests/unit/test_exposition.py asserts real
+snapshots against this table, and scripts/check_metrics_docs.py
+cross-checks the README's documented metrics table) instead of silently
+breaking dashboards.
+
+Two namespaces share one declaration:
+
+  * `line_key` — the additive CamelCase key on the legacy 29 s JSON
+    line (obs/metrics.py).  The reference's five keys keep their exact
+    bytes (REFERENCE_LINE_KEYS); everything else is additive.
+  * `prom` — the `banjax_*` family `/metrics` exposes
+    (obs/exposition.py).  Interval-window keys (lines/sec, per-interval
+    deltas) are line-only: Prometheus computes rates server-side from
+    the monotone totals, and exposing the resetting window would make
+    scrapes steal the 29 s line's deltas.
+
+Histograms (fixed buckets, cumulative) live here too so the recorder
+(obs/stats.py, pipeline/scheduler.py) and the renderer agree on bucket
+bounds by construction.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# counter: monotone total; gauge: point-in-time value; histogram:
+# fixed-bucket cumulative distribution (prom-only)
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+# the reference's exact five keys (config.go:158-181) — byte-identical,
+# asserted by tests/unit/test_exposition.py
+REFERENCE_LINE_KEYS = (
+    "Time",
+    "LenExpiringChallenges",
+    "LenExpiringBlocks",
+    "LenIpToRegexStates",
+    "LenFailedChallengeStates",
+)
+
+# fixed latency buckets (seconds) shared by every duration histogram:
+# sub-ms host stages through multi-second wedged-device tails
+LATENCY_BUCKETS_S = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Family:
+    """One declared metric family.  `line_key` and/or `prom` may be
+    empty — a family can live on one surface only."""
+
+    kind: str
+    help: str
+    line_key: str = ""
+    prom: str = ""
+    labels: Tuple[str, ...] = ()
+
+
+FAMILIES: List[Family] = [
+    # ---- reference line keys (gauges; Time is the line's timestamp) ----
+    Family(GAUGE, "metrics line timestamp (reference format)",
+           line_key="Time"),
+    Family(GAUGE, "expiring challenge decisions held",
+           line_key="LenExpiringChallenges",
+           prom="banjax_expiring_challenges"),
+    Family(GAUGE, "expiring block decisions held",
+           line_key="LenExpiringBlocks", prom="banjax_expiring_blocks"),
+    Family(GAUGE, "per-IP regex rate-limit states held",
+           line_key="LenIpToRegexStates",
+           prom="banjax_ip_to_regex_states"),
+    Family(GAUGE, "failed-challenge rate-limit states held",
+           line_key="LenFailedChallengeStates",
+           prom="banjax_failed_challenge_states"),
+    # ---- matcher core ----
+    Family(COUNTER, "log lines consumed by the matcher",
+           line_key="MatcherLinesTotal", prom="banjax_matcher_lines_total"),
+    Family(COUNTER, "matcher batches consumed",
+           line_key="MatcherBatchesTotal",
+           prom="banjax_matcher_batches_total"),
+    Family(GAUGE, "lines/sec over the last reporting interval (line-only; "
+           "Prometheus rates banjax_matcher_lines_total instead)",
+           line_key="MatcherLinesPerSec"),
+    Family(GAUGE, "p50 batch latency (ms) over the recent-latency ring",
+           line_key="MatcherBatchLatencyP50Ms"),
+    Family(GAUGE, "p99 batch latency (ms) over the recent-latency ring",
+           line_key="MatcherBatchLatencyP99Ms"),
+    Family(COUNTER, "host->device bytes moved by the matcher",
+           line_key="MatcherH2dBytesTotal",
+           prom="banjax_matcher_h2d_bytes_total"),
+    Family(COUNTER, "device->host bytes moved by the matcher",
+           line_key="MatcherD2hBytesTotal",
+           prom="banjax_matcher_d2h_bytes_total"),
+    Family(GAUGE, "h2d bytes per batch over this interval (the fused-path "
+           "dense-reupload witness)", line_key="MatcherH2dBytesPerBatch"),
+    Family(GAUGE, "d2h bytes per batch over this interval",
+           line_key="MatcherD2hBytesPerBatch"),
+    # ---- device windows ----
+    Family(GAUGE, "device window slots occupied",
+           line_key="DeviceWindowsOccupancy",
+           prom="banjax_device_windows_occupancy"),
+    Family(GAUGE, "device window slot capacity",
+           line_key="DeviceWindowsCapacity",
+           prom="banjax_device_windows_capacity"),
+    Family(COUNTER, "device window LRU evictions (spill to host shadow)",
+           line_key="DeviceWindowsEvictions",
+           prom="banjax_device_windows_evictions_total"),
+    Family(GAUGE, "evictions in this reporting interval (line-only delta)",
+           line_key="DeviceWindowsEvictionsPerInterval"),
+    Family(COUNTER, "device window capacity grows",
+           line_key="DeviceWindowsGrows",
+           prom="banjax_device_windows_grows_total"),
+    Family(GAUGE, "1 when the native C slot manager is live, 0 on the "
+           "Python dict path", line_key="SlotMgrNative",
+           prom="banjax_slotmgr_native"),
+    Family(GAUGE, "IPs with live window counters (evicted/spilled included)",
+           line_key="DeviceWindowsShadowedIps",
+           prom="banjax_device_windows_shadowed_ips"),
+    # ---- mesh ----
+    Family(COUNTER, "sharded-mesh batches served by the fused two-stage path",
+           line_key="MeshFusedBatches", prom="banjax_mesh_fused_batches_total"),
+    Family(COUNTER, "sharded-mesh batches that fell back single-stage",
+           line_key="MeshFallbackBatches",
+           prom="banjax_mesh_fallback_batches_total"),
+    Family(GAUGE, "EWMA mesh submit wall time (ms)",
+           line_key="MeshSubmitMsEwma", prom="banjax_mesh_submit_ms_ewma"),
+    Family(GAUGE, "EWMA mesh d2h merge wall time (ms)",
+           line_key="MeshMergeMsEwma", prom="banjax_mesh_merge_ms_ewma"),
+    Family(GAUGE, "slowest shard's d2h pull in the last merge (ms)",
+           line_key="MeshShardMergeMsMax",
+           prom="banjax_mesh_shard_merge_ms_max"),
+    Family(GAUGE, "1 when the two-stage literal prefilter is active",
+           line_key="PrefilterActive", prom="banjax_prefilter_active"),
+    # ---- fused matcher+windows ----
+    Family(COUNTER, "sync-path fused matcher+windows batches",
+           line_key="PipelineFusedBatches",
+           prom="banjax_fused_batches_total"),
+    Family(COUNTER, "fallback batches (fused overflow / pipeline generic "
+           "drain)", line_key="PipelineFallbackBatches",
+           prom="banjax_fused_fallback_batches_total"),
+    Family(COUNTER, "two-phase fused chunks committed via the pipeline",
+           line_key="PipelinedFusedChunks",
+           prom="banjax_pipelined_fused_chunks_total"),
+    Family(COUNTER, "two-phase chunks replayed classically (overflow)",
+           line_key="PipelinedFusedFallbacks",
+           prom="banjax_pipelined_fused_fallbacks_total"),
+    Family(GAUGE, "configured fused-drain resolve-ahead depth",
+           line_key="DrainResolveAheadDepth",
+           prom="banjax_drain_resolve_ahead_depth"),
+    Family(GAUGE, "EWMA event-decode+replay ms hidden behind the next "
+           "chunk's window program", line_key="DrainResolveOverlapMs",
+           prom="banjax_drain_resolve_overlap_ms"),
+    # ---- breaker / degraded mode ----
+    Family(GAUGE, "circuit breaker state (one-hot by state label)",
+           line_key="MatcherBreakerState",
+           prom="banjax_matcher_breaker_state", labels=("state",)),
+    Family(COUNTER, "circuit breaker trips",
+           line_key="MatcherBreakerTrips",
+           prom="banjax_matcher_breaker_trips_total"),
+    Family(COUNTER, "batches served by the CPU reference matcher (degraded)",
+           line_key="MatcherCpuFallbackBatches",
+           prom="banjax_matcher_cpu_fallback_batches_total"),
+    # ---- pipeline scheduler ----
+    Family(COUNTER, "lines+commands admitted into the pipeline",
+           line_key="PipelineAdmittedLines",
+           prom="banjax_pipeline_admitted_lines_total"),
+    Family(COUNTER, "lines+commands fully drained",
+           line_key="PipelineProcessedLines",
+           prom="banjax_pipeline_processed_lines_total"),
+    Family(COUNTER, "lines shed oldest-first under overload",
+           line_key="PipelineShedLines",
+           prom="banjax_pipeline_shed_lines_total"),
+    Family(COUNTER, "lines lost to drain-stage failures (counted, never "
+           "silent)", line_key="PipelineDrainErrorLines",
+           prom="banjax_pipeline_drain_error_lines_total"),
+    Family(COUNTER, "lines dropped stale at effector drain (10 s cutoff)",
+           line_key="PipelineStaleDroppedLines",
+           prom="banjax_pipeline_stale_dropped_lines_total"),
+    Family(COUNTER, "pipeline batches drained",
+           line_key="PipelineBatches", prom="banjax_pipeline_batches_total"),
+    Family(COUNTER, "kafka command messages drained in admission order",
+           line_key="PipelineCommandItems",
+           prom="banjax_pipeline_command_items_total"),
+    Family(COUNTER, "kafka command batches drained",
+           line_key="PipelineCommandBatches",
+           prom="banjax_pipeline_command_batches_total"),
+    Family(COUNTER, "synthetic idle-probe failures",
+           line_key="PipelineProbeFailures",
+           prom="banjax_pipeline_probe_failures_total"),
+    Family(GAUGE, "EWMA p99 of the device stage (ms) — feeds the derived "
+           "breaker budget", line_key="PipelineDeviceP99Ms"),
+    Family(GAUGE, "adaptive batch-size target (power-of-two bucket)",
+           line_key="PipelineBatchTarget",
+           prom="banjax_pipeline_batch_target"),
+    Family(GAUGE, "command-batch take bound",
+           line_key="PipelineCommandBatchTarget",
+           prom="banjax_pipeline_command_batch_target"),
+    Family(GAUGE, "EWMA encode-stage wall per batch (ms)",
+           line_key="PipelineStageEncodeEwmaMs"),
+    Family(GAUGE, "EWMA device-stage wall per batch (ms)",
+           line_key="PipelineStageDeviceEwmaMs"),
+    Family(GAUGE, "EWMA drain-stage wall per batch (ms)",
+           line_key="PipelineStageDrainEwmaMs"),
+    Family(GAUGE, "lines waiting in the admission buffer",
+           line_key="PipelineBufferedLines",
+           prom="banjax_pipeline_buffered_lines"),
+    Family(GAUGE, "batches in flight across the stage ring",
+           line_key="PipelineInflightBatches",
+           prom="banjax_pipeline_inflight_batches"),
+    Family(GAUGE, "configured in-flight ring size",
+           line_key="PipelineRingSize", prom="banjax_pipeline_ring_size"),
+    # ---- encode worker pool ----
+    Family(GAUGE, "configured encode worker count (0 = single-thread)",
+           line_key="EncodeWorkers", prom="banjax_encode_workers"),
+    Family(COUNTER, "admission batches encoded via the sharded worker pool",
+           line_key="EncodeShardedBatches",
+           prom="banjax_encode_sharded_batches_total"),
+    Family(GAUGE, "slowest encode shard's wall (ms) this interval",
+           line_key="EncodeShardMsMax"),
+    Family(GAUGE, "EWMA encode-pool utilization (1.0 = perfectly balanced)",
+           line_key="EncodeWorkerUtilization",
+           prom="banjax_encode_worker_utilization"),
+    Family(GAUGE, "worst shard skew (max/mean shard wall) this interval",
+           line_key="EncodeShardSkewMax",
+           prom="banjax_encode_shard_skew_max"),
+    Family(GAUGE, "EWMA per-worker busy fraction of fan-out wall (prom-"
+           "only; per-shard-index label)",
+           prom="banjax_encode_worker_busy_fraction", labels=("worker",)),
+    # ---- kafka / http workers / health ----
+    Family(COUNTER, "kafka record batches skipped (undecodable codec)",
+           line_key="KafkaSkippedBatches",
+           prom="banjax_kafka_skipped_batches_total"),
+    Family(GAUGE, "live SO_REUSEPORT http worker processes",
+           line_key="HttpWorkers", prom="banjax_http_workers"),
+    Family(COUNTER, "http workers respawned after a crash",
+           line_key="HttpWorkerRespawns",
+           prom="banjax_http_worker_respawns_total"),
+    Family(COUNTER, "failed-challenge states dropped by the shm limiter",
+           line_key="HttpFcDropped", prom="banjax_http_fc_dropped_total"),
+    Family(GAUGE, "aggregate health (0 healthy / 1 degraded / 2 failed)",
+           line_key="HealthStatus", prom="banjax_health_status"),
+    Family(GAUGE, "per-component health (0 healthy / 1 degraded / 2 "
+           "failed); Health_<name> on the line",
+           prom="banjax_health_component_status", labels=("component",)),
+    # ---- histograms (prom-only) ----
+    Family(HISTOGRAM, "end-to-end matcher batch latency (s)",
+           prom="banjax_batch_latency_seconds"),
+    Family(HISTOGRAM, "device stage (submit->collect) latency (s)",
+           prom="banjax_device_stage_latency_seconds"),
+    Family(HISTOGRAM, "per-stage pipeline span duration (s)",
+           prom="banjax_stage_duration_seconds", labels=("stage",)),
+]
+
+# dynamic line-key prefixes (one key per registered component)
+DYNAMIC_LINE_PREFIXES = ("Health_",)
+
+LINE_KEYS: Dict[str, Family] = {
+    f.line_key: f for f in FAMILIES if f.line_key
+}
+PROM_FAMILIES: Dict[str, Family] = {f.prom: f for f in FAMILIES if f.prom}
+
+
+def is_declared_line_key(key: str) -> bool:
+    if key in LINE_KEYS:
+        return True
+    return any(key.startswith(p) for p in DYNAMIC_LINE_PREFIXES)
+
+
+class Histogram:
+    """Thread-safe fixed-bucket histogram (Prometheus cumulative
+    semantics at render time; counts stored per-bucket here)."""
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, bounds: Tuple[float, ...] = LATENCY_BUCKETS_S):
+        self.bounds = tuple(bounds)
+        self._counts = [0] * (len(self.bounds) + 1)  # +1 for +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> Tuple[Tuple[float, ...], List[int], float, int]:
+        """(bounds, cumulative_counts incl. +Inf, sum, count)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum = []
+        running = 0
+        for c in counts:
+            running += c
+            cum.append(running)
+        return self.bounds, cum, s, total
+
+
+class StageHistograms:
+    """A labeled histogram set keyed by stage name, created lazily so
+    only stages that actually run appear in the exposition."""
+
+    __slots__ = ("_hists", "_lock")
+
+    def __init__(self):
+        self._hists: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, stage: str, value_s: float) -> None:
+        h = self._hists.get(stage)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(stage, Histogram())
+        h.observe(value_s)
+
+    def items(self):
+        with self._lock:
+            return sorted(self._hists.items())

@@ -5,13 +5,33 @@ bench.py).
 MatcherStats is a thread-safe accumulator every Matcher carries; the
 29-second metrics line (obs/metrics.py) snapshots it with ADDITIVE keys —
 the reference's five keys keep their exact schema
-(/root/reference/config.go:158-181)."""
+(/root/reference/config.go:158-181).
+
+Two consumers read these accumulators with different contracts:
+
+  * `snapshot()` — the 29 s line's view: includes INTERVAL keys
+    (lines/sec window, per-batch byte averages, eviction deltas) and
+    resets them.  Read+reset is ONE atomic lock section (a scrape
+    landing between a read and its reset used to lose or double-count
+    the delta — tests/unit/test_observability.py hammers it now); the
+    single-periodic-consumer assumption still applies to the VALUES
+    (two competing periodic consumers would each see partial windows).
+  * `peek()` — the Prometheus exposition's view (obs/exposition.py):
+    monotone totals and point-in-time gauges only, never touching the
+    window state, so scrapes at any cadence cannot steal the line's
+    deltas.  Rate math belongs to the scraper.
+
+Every key either view emits is declared in obs/registry.py — the
+exposition-schema registry CI locks (test_exposition.py).
+"""
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
+
+from banjax_tpu.obs.registry import Histogram, StageHistograms
 
 _LATENCY_RING = 512  # recent batch latencies kept for the percentiles
 _DEVICE_RING = 256   # recent device-stage latencies for the pipeline p99
@@ -38,8 +58,12 @@ class MatcherStats:
         self._window_h2d = 0
         self._window_d2h = 0
         self._window_batches = 0
+        # fixed-bucket batch-latency distribution for /metrics (registry
+        # buckets; same observations as the p50/p99 ring)
+        self.batch_latency_hist = Histogram()
 
     def record_batch(self, n_lines: int, elapsed_s: float) -> None:
+        self.batch_latency_hist.observe(elapsed_s)
         with self._lock:
             self.lines_total += n_lines
             self.batches_total += 1
@@ -64,57 +88,30 @@ class MatcherStats:
         with self._lock:
             return self.h2d_bytes_total / max(1, self.batches_total)
 
-    def snapshot(self, device_windows=None, matcher=None) -> Dict[str, object]:
-        """Additive metrics-line keys; resets the lines/sec window."""
-        with self._lock:
-            now = time.monotonic()
-            dt = max(now - self._window_start, 1e-9)
-            lps = self._window_lines / dt
-            self._window_lines = 0
-            self._window_start = now
-            n = min(self._lat_n, _LATENCY_RING)
-            lats = sorted(self._latencies[:n])
-            out: Dict[str, object] = {
-                "MatcherLinesTotal": self.lines_total,
-                "MatcherBatchesTotal": self.batches_total,
-                "MatcherLinesPerSec": round(lps, 1),
-                "MatcherBatchLatencyP50Ms": (
-                    round(lats[n // 2] * 1e3, 3) if n else None
-                ),
-                "MatcherBatchLatencyP99Ms": (
-                    round(lats[min(n - 1, (n * 99) // 100)] * 1e3, 3) if n else None
-                ),
-                "MatcherH2dBytesTotal": self.h2d_bytes_total,
-                "MatcherD2hBytesTotal": self.d2h_bytes_total,
-                # per-batch averages over THIS reporting interval: the
-                # operator-visible witness that fused+pipelined killed the
-                # ~16 MB/batch dense re-upload
-                "MatcherH2dBytesPerBatch": round(
-                    self._window_h2d / max(1, self._window_batches), 1
-                ),
-                "MatcherD2hBytesPerBatch": round(
-                    self._window_d2h / max(1, self._window_batches), 1
-                ),
-            }
-            self._window_h2d = 0
-            self._window_d2h = 0
-            self._window_batches = 0
+    def _percentiles_locked(self) -> Dict[str, object]:
+        n = min(self._lat_n, _LATENCY_RING)
+        lats = sorted(self._latencies[:n])
+        return {
+            "MatcherBatchLatencyP50Ms": (
+                round(lats[n // 2] * 1e3, 3) if n else None
+            ),
+            "MatcherBatchLatencyP99Ms": (
+                round(lats[min(n - 1, (n * 99) // 100)] * 1e3, 3) if n else None
+            ),
+        }
+
+    @staticmethod
+    def _derived(device_windows=None, matcher=None) -> Dict[str, object]:
+        """Non-stats-owned keys (device windows, mesh, fused pipeline,
+        breaker).  Reads foreign objects only — no stats lock, no resets
+        — so both snapshot() and peek() share it."""
+        out: Dict[str, object] = {}
         if device_windows is not None:
             out["DeviceWindowsOccupancy"] = device_windows.occupancy
             out["DeviceWindowsCapacity"] = device_windows.capacity
-            # single read: an eviction landing between two reads must not be
-            # dropped from the next interval's delta
-            evictions = device_windows.eviction_count
-            out["DeviceWindowsEvictions"] = evictions
-            # churn rate: evictions in THIS reporting interval — degraded
-            # (spill/restore) mode is visible per 29 s line, not only as a
-            # lifetime total.  Interval deltas assume a single periodic
-            # consumer (the metrics loop); ad-hoc snapshot() callers steal
-            # the delta from the next metrics line.
-            out["DeviceWindowsEvictionsPerInterval"] = (
-                evictions - self._last_evictions
-            )
-            self._last_evictions = evictions
+            # single read: an eviction landing between two reads must not
+            # be dropped from the next interval's delta
+            out["DeviceWindowsEvictions"] = device_windows.eviction_count
             out["DeviceWindowsGrows"] = getattr(device_windows, "grow_count", 0)
             # which slot-assignment path is live: the native C manager
             # (native/slotmgr.c) or the Python dict+LRU fallback/oracle
@@ -179,6 +176,71 @@ class MatcherStats:
                 )
         return out
 
+    def snapshot(self, device_windows=None, matcher=None) -> Dict[str, object]:
+        """Additive metrics-line keys; resets the interval windows.
+
+        The foreign reads (_derived) happen OUTSIDE the stats lock; every
+        read-then-reset of stats-owned window state — including the
+        eviction-delta bookkeeping, which used to update `_last_evictions`
+        unlocked — is one atomic section, so concurrent snapshot callers
+        telescope cleanly instead of double-counting a delta."""
+        derived = self._derived(device_windows, matcher)
+        evictions = derived.get("DeviceWindowsEvictions")
+        with self._lock:
+            now = time.monotonic()
+            dt = max(now - self._window_start, 1e-9)
+            lps = self._window_lines / dt
+            self._window_lines = 0
+            self._window_start = now
+            out: Dict[str, object] = {
+                "MatcherLinesTotal": self.lines_total,
+                "MatcherBatchesTotal": self.batches_total,
+                "MatcherLinesPerSec": round(lps, 1),
+                **self._percentiles_locked(),
+                "MatcherH2dBytesTotal": self.h2d_bytes_total,
+                "MatcherD2hBytesTotal": self.d2h_bytes_total,
+                # per-batch averages over THIS reporting interval: the
+                # operator-visible witness that fused+pipelined killed the
+                # ~16 MB/batch dense re-upload
+                "MatcherH2dBytesPerBatch": round(
+                    self._window_h2d / max(1, self._window_batches), 1
+                ),
+                "MatcherD2hBytesPerBatch": round(
+                    self._window_d2h / max(1, self._window_batches), 1
+                ),
+            }
+            self._window_h2d = 0
+            self._window_d2h = 0
+            self._window_batches = 0
+            if evictions is not None:
+                # churn rate: evictions in THIS reporting interval —
+                # degraded (spill/restore) mode visible per 29 s line, not
+                # only as a lifetime total.  Interval deltas assume a
+                # single periodic consumer (the metrics loop); /metrics
+                # scrapes use peek() and never touch this.
+                out["DeviceWindowsEvictionsPerInterval"] = (
+                    evictions - self._last_evictions
+                )
+                self._last_evictions = evictions
+        out.update(derived)
+        return out
+
+    def peek(self, device_windows=None, matcher=None) -> Dict[str, object]:
+        """Non-destructive view for the Prometheus exposition: totals,
+        percentiles and derived gauges only — no interval keys, no
+        resets.  Safe at any scrape cadence alongside the 29 s line."""
+        derived = self._derived(device_windows, matcher)
+        with self._lock:
+            out: Dict[str, object] = {
+                "MatcherLinesTotal": self.lines_total,
+                "MatcherBatchesTotal": self.batches_total,
+                **self._percentiles_locked(),
+                "MatcherH2dBytesTotal": self.h2d_bytes_total,
+                "MatcherD2hBytesTotal": self.d2h_bytes_total,
+            }
+        out.update(derived)
+        return out
+
 
 class PipelineStats:
     """Thread-safe counters for the streaming pipeline scheduler
@@ -218,6 +280,16 @@ class PipelineStats:
         self.encode_sharded_batches = 0
         self._encode_shard_ms_max = 0.0  # reset each snapshot
         self._encode_util_ewma: Optional[float] = None
+        # per-shard-index busy fraction (EWMA of shard wall / fan-out
+        # wall) and max/mean skew — the real multi-core imbalance signal
+        # the scalar utilization EWMA hides (ROADMAP PR 4 follow-up);
+        # skew: interval max for the 29 s line, EWMA for /metrics
+        self._worker_busy_ewma: List[float] = []
+        self._shard_skew_max = 0.0       # reset each snapshot
+        self._shard_skew_ewma: Optional[float] = None
+        # fixed-bucket distributions for /metrics (obs/registry.py)
+        self.device_latency_hist = Histogram()
+        self.stage_hists = StageHistograms()
 
     def note_admitted(self, n: int) -> None:
         with self._lock:
@@ -250,21 +322,48 @@ class PipelineStats:
             self.command_items += n
             self.command_batches += 1
 
-    def note_encode_shards(
-        self, max_ms: float, utilization: float, n_shards: int
-    ) -> None:
-        """One sharded encode fan-out's timing (scheduler._begin_state)."""
-        del n_shards  # recorded for signature clarity; keys cover max/util
+    def note_encode_shards(self, shard_ms: List[float],
+                           wall_ms: float) -> None:
+        """One sharded encode fan-out's timing (scheduler._begin_state):
+        per-shard wall times plus the fan-out's total wall."""
+        n_shards = len(shard_ms)
+        if not n_shards:
+            return
+        wall = max(wall_ms, 1e-9)
+        mean = sum(shard_ms) / n_shards
+        skew = (max(shard_ms) / mean) if mean > 0 else 1.0
+        util = min(1.0, max(0.0, sum(shard_ms) / (wall * n_shards)))
         with self._lock:
             self.encode_sharded_batches += 1
-            if max_ms > self._encode_shard_ms_max:
-                self._encode_shard_ms_max = max_ms
-            u = min(1.0, max(0.0, utilization))
+            if max(shard_ms) > self._encode_shard_ms_max:
+                self._encode_shard_ms_max = max(shard_ms)
             self._encode_util_ewma = (
-                u if self._encode_util_ewma is None
+                util if self._encode_util_ewma is None
                 else self._encode_util_ewma
-                + 0.3 * (u - self._encode_util_ewma)
+                + 0.3 * (util - self._encode_util_ewma)
             )
+            if skew > self._shard_skew_max:
+                self._shard_skew_max = skew
+            self._shard_skew_ewma = (
+                skew if self._shard_skew_ewma is None
+                else self._shard_skew_ewma
+                + 0.3 * (skew - self._shard_skew_ewma)
+            )
+            while len(self._worker_busy_ewma) < n_shards:
+                self._worker_busy_ewma.append(0.0)
+            for k, ms in enumerate(shard_ms):
+                frac = min(1.0, ms / wall)
+                prev = self._worker_busy_ewma[k]
+                self._worker_busy_ewma[k] = (
+                    frac if self.encode_sharded_batches == 1
+                    else prev + 0.3 * (frac - prev)
+                )
+
+    def worker_busy_fractions(self) -> List[float]:
+        """Per-shard-index EWMA busy fraction of the fan-out wall —
+        /metrics gauge banjax_encode_worker_busy_fraction{worker=k}."""
+        with self._lock:
+            return [round(v, 3) for v in self._worker_busy_ewma]
 
     def note_probe(self, ok: bool) -> None:
         with self._lock:
@@ -276,6 +375,7 @@ class PipelineStats:
     def observe_device(self, elapsed_s: float) -> None:
         """One device-stage (submit→collect) wall time; feeds the p99 the
         breaker-budget satellite derives `matcher_latency_budget_ms` from."""
+        self.device_latency_hist.observe(elapsed_s)
         with self._lock:
             self._device_ring[self._device_n % _DEVICE_RING] = elapsed_s
             self._device_n += 1
@@ -286,6 +386,12 @@ class PipelineStats:
                 p99 if self._device_p99_ewma is None
                 else self._device_p99_ewma + 0.2 * (p99 - self._device_p99_ewma)
             )
+
+    def observe_stages(self, stage_ms: Dict[str, float]) -> None:
+        """Per-stage wall times for one drained batch → the labeled
+        banjax_stage_duration_seconds histogram (scheduler drain loop)."""
+        for stage, ms in stage_ms.items():
+            self.stage_hists.observe(stage, ms / 1e3)
 
     def device_p99_s(self) -> Optional[float]:
         with self._lock:
@@ -300,29 +406,50 @@ class PipelineStats:
                 return 0.0
             return max(0.05, 3.0 * self._device_p99_ewma)
 
+    def _totals_locked(self) -> Dict[str, object]:
+        return {
+            "EncodeShardedBatches": self.encode_sharded_batches,
+            "EncodeWorkerUtilization": (
+                None if self._encode_util_ewma is None
+                else round(self._encode_util_ewma, 3)
+            ),
+            "PipelineAdmittedLines": self.admitted_lines,
+            "PipelineProcessedLines": self.processed_lines,
+            "PipelineShedLines": self.shed_lines,
+            "PipelineDrainErrorLines": self.drain_error_lines,
+            "PipelineStaleDroppedLines": self.stale_dropped_lines,
+            "PipelineBatches": self.batches,
+            "PipelineFallbackBatches": self.fallback_batches,
+            "PipelineCommandItems": self.command_items,
+            "PipelineCommandBatches": self.command_batches,
+            "PipelineProbeFailures": self.probe_failed,
+            "PipelineDeviceP99Ms": (
+                None if self._device_p99_ewma is None
+                else round(self._device_p99_ewma * 1e3, 3)
+            ),
+        }
+
     def snapshot(self) -> Dict[str, object]:
+        """29 s line view: totals plus the interval maxima, which reset
+        here (read+reset is one atomic section)."""
         with self._lock:
-            p99 = self._device_p99_ewma
             shard_max = self._encode_shard_ms_max
             self._encode_shard_ms_max = 0.0  # interval max, like a gauge
-            return {
-                "EncodeShardedBatches": self.encode_sharded_batches,
-                "EncodeShardMsMax": round(shard_max, 3),
-                "EncodeWorkerUtilization": (
-                    None if self._encode_util_ewma is None
-                    else round(self._encode_util_ewma, 3)
-                ),
-                "PipelineAdmittedLines": self.admitted_lines,
-                "PipelineProcessedLines": self.processed_lines,
-                "PipelineShedLines": self.shed_lines,
-                "PipelineDrainErrorLines": self.drain_error_lines,
-                "PipelineStaleDroppedLines": self.stale_dropped_lines,
-                "PipelineBatches": self.batches,
-                "PipelineFallbackBatches": self.fallback_batches,
-                "PipelineCommandItems": self.command_items,
-                "PipelineCommandBatches": self.command_batches,
-                "PipelineProbeFailures": self.probe_failed,
-                "PipelineDeviceP99Ms": (
-                    None if p99 is None else round(p99 * 1e3, 3)
-                ),
-            }
+            skew_max = self._shard_skew_max
+            self._shard_skew_max = 0.0
+            out = self._totals_locked()
+            out["EncodeShardMsMax"] = round(shard_max, 3)
+            out["EncodeShardSkewMax"] = round(skew_max, 3)
+            return out
+
+    def peek(self) -> Dict[str, object]:
+        """Prometheus view: totals and EWMAs only, no interval resets.
+        Shard skew is the EWMA here (an interval max is meaningless
+        across uncoordinated scrapers)."""
+        with self._lock:
+            out = self._totals_locked()
+            out["EncodeShardSkewMax"] = (
+                None if self._shard_skew_ewma is None
+                else round(self._shard_skew_ewma, 3)
+            )
+            return out

@@ -1,0 +1,372 @@
+"""Pipeline tracing: a lock-cheap, ring-buffered span recorder.
+
+The reference banjax exposes a 29-second status line and nothing else;
+this reproduction has four overlapped pipeline stages, a fused
+two-program device path, sharded encode workers, and a resolve-ahead
+drain — none of it visible per-batch.  This module is the Dapper-style
+propagation layer: every admission batch gets a trace id at the
+scheduler's take-time and carries it through encode (per-shard child
+spans), submit (program-A dispatch, mesh shard submits), collect, and
+drain (program-B commit, effector replay), with breaker/fallback/shed
+events as instant annotations.
+
+Design constraints, in order:
+
+  * **Off ≈ free.**  `trace_enabled` defaults false; every record path
+    starts with one attribute check and returns a shared no-op object —
+    no allocation, no lock, no clock read.  bench.py --trace-overhead
+    banks the measured on/off delta (BENCH_trace_overhead.json).
+  * **On = lock-cheap.**  A completed span is one lock acquisition and
+    a handful of stores into a preallocated ring (`trace_ring_size`
+    slots, oldest overwritten).  Nothing is formatted or allocated per
+    span beyond the record tuple; export pays the formatting cost.
+  * **Cross-thread spans are explicit.**  A batch's root span begins on
+    the encode thread and ends on the drain thread, so the root rides
+    the batch object (`begin`/`end`), while single-thread stage spans
+    use the context-manager form, which also maintains a thread-local
+    ambient parent — nested spans recorded inside the matcher (program
+    B, effector replay, mesh shard pulls) auto-parent without the
+    matcher knowing about the scheduler's ids.
+
+Export: `export_chrome()` renders the ring as Chrome `trace_event`
+JSON — load the `/debug/trace` dump straight into Perfetto
+(https://ui.perfetto.dev) or chrome://tracing.  Span args become event
+`args`; thread names are emitted as metadata events so each pipeline
+stage gets its own named track.
+
+JAX bridge: with `trace_jax_annotations` on, context-manager spans also
+enter `jax.profiler.TraceAnnotation(name)` so host spans line up with
+the XLA/TPU device timeline whenever a profiler session (the
+/debug/jax/trace route, or an external `jax.profiler.start_trace`) is
+active; the annotations are no-ops otherwise.  The root batch span
+additionally wraps its submit stage in `StepTraceAnnotation` with the
+trace id as the step number, which Perfetto/xprof group per step.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+DEFAULT_RING_SIZE = 4096
+
+# the five pipeline stage span names the acceptance test asserts on
+STAGES = ("admission", "encode", "encode-shard", "submit", "collect", "drain")
+
+
+class _NoopSpan:
+    """Shared do-nothing span: returned whenever recording is off (or the
+    caller has no trace), so call sites never branch on enablement."""
+
+    __slots__ = ()
+    trace_id = 0
+    span_id = 0
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def note(self, key: str, value) -> None:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One live span.  Mutable while open; recorded into the ring on
+    `end()`/`__exit__`.  `note()` attaches args visible in the export
+    (breaker state, fallback reasons, row counts)."""
+
+    __slots__ = ("tracer", "trace_id", "span_id", "parent_id", "name",
+                 "t0", "args", "_thread_name", "_jax_ctx")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: int,
+                 parent_id: int, args: Optional[dict]):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = next(tracer._ids)
+        self.parent_id = parent_id
+        self.name = name
+        self.args = dict(args) if args else None
+        self.t0 = time.perf_counter()
+        self._thread_name = threading.current_thread().name
+        self._jax_ctx = None
+
+    def note(self, key: str, value) -> None:
+        if self.args is None:
+            self.args = {}
+        self.args[key] = value
+
+    # -- context-manager form (single-thread spans; maintains the ambient
+    # parent stack and the optional jax annotation) --
+
+    def __enter__(self) -> "Span":
+        stack = self.tracer._ambient.__dict__.setdefault("stack", [])
+        stack.append(self)
+        if self.tracer.jax_annotations:
+            self._jax_ctx = self.tracer._enter_jax(self.name)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._jax_ctx is not None:
+            try:
+                self._jax_ctx.__exit__(exc_type, exc, tb)
+            except Exception:  # noqa: BLE001 — tracing must never raise
+                pass
+            self._jax_ctx = None
+        stack = self.tracer._ambient.__dict__.get("stack")
+        if stack and stack[-1] is self:
+            stack.pop()
+        if exc_type is not None:
+            self.note("error", repr(exc))
+        self.tracer.end(self)
+
+
+class Tracer:
+    """Process-wide span recorder.  All public methods are safe to call
+    from any thread; when `enabled` is False every one of them is a
+    single attribute check."""
+
+    def __init__(self, enabled: bool = False,
+                 ring_size: int = DEFAULT_RING_SIZE,
+                 jax_annotations: bool = False):
+        self.enabled = bool(enabled)
+        self.jax_annotations = bool(jax_annotations)
+        self.ring_size = max(16, int(ring_size))
+        self._lock = threading.Lock()
+        self._ring: List[Optional[tuple]] = [None] * self.ring_size
+        self._n = 0  # monotone record count; ring index = _n % ring_size
+        self._dropped = 0
+        self._ids = itertools.count(1)
+        self._traces = itertools.count(1)
+        self._ambient = threading.local()
+        self._epoch = time.perf_counter()
+        self._epoch_wall = time.time()
+
+    # ---- recording ----
+
+    def new_trace(self) -> int:
+        """Allocate a trace id for one admission batch; 0 when disabled
+        (0 propagates as 'don't record' through every span call)."""
+        if not self.enabled:
+            return 0
+        return next(self._traces)
+
+    def begin(self, name: str, trace_id: int, parent: int = 0,
+              args: Optional[dict] = None):
+        """Open a span explicitly (cross-thread form: `end()` may run on
+        a different thread).  Does NOT touch the ambient stack."""
+        if not self.enabled or not trace_id:
+            return NOOP_SPAN
+        return Span(self, name, trace_id, parent, args)
+
+    def end(self, span) -> None:
+        """Close a span opened with `begin()` (or via __exit__)."""
+        if span is NOOP_SPAN or not isinstance(span, Span):
+            return
+        dur_us = (time.perf_counter() - span.t0) * 1e6
+        t0_us = (span.t0 - self._epoch) * 1e6
+        rec = (span.trace_id, span.span_id, span.parent_id, span.name,
+               t0_us, dur_us, span._thread_name, span.args)
+        with self._lock:
+            self._ring[self._n % self.ring_size] = rec
+            self._n += 1
+
+    def span(self, name: str, trace_id: Optional[int] = None,
+             parent: Optional[int] = None, args: Optional[dict] = None):
+        """Context-manager span.  With no explicit ids it parents under
+        the thread's current ambient span — and records nothing when
+        there is none, so instrumented library code (matcher, mesh) is
+        inert outside a traced pipeline batch."""
+        if not self.enabled:
+            return NOOP_SPAN
+        if trace_id is None or parent is None:
+            stack = self._ambient.__dict__.get("stack")
+            top = stack[-1] if stack else None
+            if trace_id is None:
+                if top is None:
+                    return NOOP_SPAN
+                trace_id = top.trace_id
+            if parent is None:
+                parent = top.span_id if top is not None else 0
+        if not trace_id:
+            return NOOP_SPAN
+        return Span(self, name, trace_id, parent, args)
+
+    def instant(self, name: str, args: Optional[dict] = None,
+                trace_id: int = 0) -> None:
+        """Point event (shed, breaker trip, fallback): zero duration,
+        recorded even without a trace id so stream-level events (an
+        admission-buffer shed belongs to no single batch) still land in
+        the ring."""
+        if not self.enabled:
+            return
+        t0_us = (time.perf_counter() - self._epoch) * 1e6
+        rec = (trace_id, next(self._ids), 0, name, t0_us, None,
+               threading.current_thread().name, dict(args) if args else None)
+        with self._lock:
+            self._ring[self._n % self.ring_size] = rec
+            self._n += 1
+
+    # ---- export ----
+
+    def snapshot(self) -> List[dict]:
+        """Ring contents oldest-first as plain dicts (tests, debugging)."""
+        with self._lock:
+            n = self._n
+            if n <= self.ring_size:
+                recs = [r for r in self._ring[:n]]
+            else:
+                cut = n % self.ring_size
+                recs = self._ring[cut:] + self._ring[:cut]
+        out = []
+        for r in recs:
+            if r is None:
+                continue
+            tid, sid, pid, name, t0_us, dur_us, thread, args = r
+            out.append({
+                "trace_id": tid, "span_id": sid, "parent_id": pid,
+                "name": name, "t0_us": t0_us, "dur_us": dur_us,
+                "thread": thread, "args": args or {},
+            })
+        return out
+
+    def export_chrome(self) -> dict:
+        """Chrome trace_event JSON (Perfetto / chrome://tracing).
+
+        Complete ('X') events for spans, instant ('i') events for
+        annotations; one virtual pid, one tid per recorded thread name
+        with 'M' metadata naming the track.  Span/trace ids ride in
+        args so Perfetto's query surface can join parent/child."""
+        spans = self.snapshot()
+        tids: Dict[str, int] = {}
+        events = []
+        pid = os.getpid()
+        for s in spans:
+            tid = tids.setdefault(s["thread"], len(tids) + 1)
+            args = dict(s["args"])
+            args["trace_id"] = s["trace_id"]
+            args["span_id"] = s["span_id"]
+            if s["parent_id"]:
+                args["parent_span_id"] = s["parent_id"]
+            ev = {
+                "name": s["name"],
+                "cat": "banjax",
+                "ph": "X" if s["dur_us"] is not None else "i",
+                "ts": round(s["t0_us"], 3),
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            }
+            if s["dur_us"] is not None:
+                ev["dur"] = round(s["dur_us"], 3)
+            else:
+                ev["s"] = "g"  # global-scope instant
+            events.append(ev)
+        meta = [
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": thread}}
+            for thread, tid in tids.items()
+        ]
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "tool": "banjax-tpu trace ring",
+                "ring_size": self.ring_size,
+                "recorded": self._n,
+                "epoch_unix": self._epoch_wall,
+            },
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = [None] * self.ring_size
+            self._n = 0
+
+    # ---- jax profiler bridge ----
+
+    def _enter_jax(self, name: str):
+        try:
+            import jax
+
+            ctx = jax.profiler.TraceAnnotation(name)
+            ctx.__enter__()
+            return ctx
+        except Exception:  # noqa: BLE001 — the bridge is best-effort
+            return None
+
+    def step_annotation(self, trace_id: int):
+        """StepTraceAnnotation for one batch's device submit (xprof
+        groups device work per step).  Returns a context manager; a
+        no-op one when the bridge is off or jax is unavailable."""
+        if not (self.enabled and self.jax_annotations and trace_id):
+            return NOOP_SPAN
+        try:
+            import jax
+
+            return jax.profiler.StepTraceAnnotation(
+                "banjax-batch", step_num=trace_id
+            )
+        except Exception:  # noqa: BLE001
+            return NOOP_SPAN
+
+
+# ---- process-wide tracer -------------------------------------------------
+
+_tracer = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def configure(enabled: bool, ring_size: int = DEFAULT_RING_SIZE,
+              jax_annotations: bool = False) -> Tracer:
+    """(Re)configure the process tracer — called by cli.BanjaxApp from
+    config (`trace_enabled`, `trace_ring_size`, `trace_jax_annotations`)
+    and by tests.  Swaps the module singleton so a disabled tracer keeps
+    its zero-cost fast path (no indirection through a config object)."""
+    global _tracer
+    _tracer = Tracer(enabled=enabled, ring_size=ring_size,
+                     jax_annotations=jax_annotations)
+    return _tracer
+
+
+# module-level delegates: call sites read the CURRENT singleton each time
+# so a configure() mid-run (tests, SIGHUP) takes effect everywhere
+
+def enabled() -> bool:
+    return _tracer.enabled
+
+
+def new_trace() -> int:
+    return _tracer.new_trace()
+
+
+def begin(name: str, trace_id: int, parent: int = 0,
+          args: Optional[dict] = None):
+    return _tracer.begin(name, trace_id, parent, args)
+
+
+def end(span) -> None:
+    _tracer.end(span)
+
+
+def span(name: str, trace_id: Optional[int] = None,
+         parent: Optional[int] = None, args: Optional[dict] = None):
+    return _tracer.span(name, trace_id, parent, args)
+
+
+def instant(name: str, args: Optional[dict] = None, trace_id: int = 0) -> None:
+    _tracer.instant(name, args, trace_id)
+
+
+def step_annotation(trace_id: int):
+    return _tracer.step_annotation(trace_id)

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from banjax_tpu.obs import trace
+
 try:
     from jax import shard_map as _shard_map  # jax >= 0.8
 except ImportError:  # pragma: no cover — older jax
@@ -587,18 +589,22 @@ class ShardedMatchBackend:
                 self.plan = None
         if fused is not None:
             fn, params, K = fused
-            bits_d, n_cand = self._dispatch(
-                lambda p, c, ln: fn(*p, c, ln), params, cls_dev, lens_dev
-            )
-            self._async_copy(n_cand)
-            self._async_copy(bits_d)
+            with trace.span("mesh-submit",
+                            args={"dp": self.dp, "fused": True}):
+                bits_d, n_cand = self._dispatch(
+                    lambda p, c, ln: fn(*p, c, ln), params, cls_dev, lens_dev
+                )
+                self._async_copy(n_cand)
+                self._async_copy(bits_d)
             pend.update(fused=True, K=K, bits_d=bits_d, n_cand=n_cand)
             if self.health is not None:
                 self.health.beat()
         else:
             fn = self._fn(Bp, L_p)
-            out_d = self._dispatch(fn, self._params, cls_dev, lens_dev)
-            self._async_copy(out_d)
+            with trace.span("mesh-submit",
+                            args={"dp": self.dp, "fused": False}):
+                out_d = self._dispatch(fn, self._params, cls_dev, lens_dev)
+                self._async_copy(out_d)
             pend["out_d"] = out_d
         self._ewma("submit_ms_ewma", (time.perf_counter() - t0) * 1e3)
         return pend
@@ -684,7 +690,11 @@ class ShardedMatchBackend:
                 continue  # an rp replica of rows already merged
             seen.add(key)
             t0 = time.perf_counter()
-            data = np.asarray(sh.data)
+            # one span per device shard's d2h pull (child of the ambient
+            # collect/drain span when a traced pipeline batch drives this)
+            with trace.span("mesh-shard-pull",
+                            args={"shard": len(seen) - 1}):
+                data = np.asarray(sh.data)
             self.last_shard_merge_ms.append((time.perf_counter() - t0) * 1e3)
             out[idx] = data
         return out

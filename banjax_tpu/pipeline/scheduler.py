@@ -81,6 +81,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence
 
+from banjax_tpu.obs import trace
 from banjax_tpu.obs.stats import PipelineStats
 from banjax_tpu.pipeline.sizer import AdaptiveBatchSizer
 from banjax_tpu.resilience import failpoints
@@ -104,7 +105,7 @@ def resolve_encode_workers(v: int) -> int:
 
 class _Batch:
     __slots__ = ("lines", "matcher", "state", "t_encode_ms", "t_device_ms",
-                 "t0_device", "kind")
+                 "t0_device", "kind", "trace_id", "root_span")
 
     def __init__(self, lines: List[str], kind: str = "lines"):
         self.lines = lines      # log lines, or _Command items (kind="cmd")
@@ -114,6 +115,12 @@ class _Batch:
         self.t_device_ms = 0.0
         self.t0_device = 0.0
         self.kind = kind
+        # span propagation (obs/trace.py): trace id allocated at the
+        # encode stage's take; the root "admission" span opens there and
+        # closes when the drain stage finishes this batch (0/NOOP when
+        # tracing is off — every span call below no-ops on them)
+        self.trace_id = 0
+        self.root_span = trace.NOOP_SPAN
 
 
 class _Command:
@@ -301,6 +308,10 @@ class PipelineScheduler:
                     lines = lines[overflow:]
                     dropped += overflow
                 self.stats.note_shed(dropped)
+                # stream-level annotation: a shed belongs to no single
+                # batch, so it rides the ring as an instant event
+                trace.instant("shed", {"lines": dropped,
+                                       "buffered": len(self._buf)})
                 if self._health is not None:
                     self._health.degraded(f"overload: shed {dropped} lines")
             was_empty = not self._buf
@@ -361,43 +372,59 @@ class PipelineScheduler:
                 if not lines:  # a shed emptied the buffer under us
                     self._ring.release()
                     continue
-                if is_cmd:
-                    self._q_dev.put(_Batch(lines, kind="cmd"))
-                else:
-                    self._q_dev.put(self._encode_batch(lines))
+                # take-time is where a batch exists as a unit: allocate its
+                # trace id here so admission-buffer wait is excluded but
+                # every stage (incl. queueing between stages) is covered
+                batch = _Batch(lines, kind="cmd" if is_cmd else "lines")
+                if trace.enabled():
+                    batch.trace_id = trace.new_trace()
+                    batch.root_span = trace.begin(
+                        "admission", batch.trace_id,
+                        args={"items": len(lines), "kind": batch.kind},
+                    )
+                if not is_cmd:
+                    self._encode_batch(batch)
+                self._q_dev.put(batch)
         finally:
             self._q_dev.put(None)
 
-    def _encode_batch(self, lines: List[str]) -> _Batch:
-        batch = _Batch(lines)
+    def _encode_batch(self, batch: _Batch) -> None:
+        lines = batch.lines
         t0 = time.perf_counter()
         matcher = self._matcher_getter()
         batch.matcher = matcher
         breaker = getattr(matcher, "breaker", None)
-        # breaker OPEN: skip the split encode entirely — the generic drain
-        # re-parses inside consume_lines, which routes to the CPU fallback
-        if hasattr(matcher, "pipeline_begin") and not (
-            breaker is not None and breaker.state == OPEN
-        ):
-            if hasattr(matcher, "set_latency_budget_source"):
-                # breaker-budget satellite: when matcher_latency_budget_ms
-                # is unset the breaker derives it from this pipeline's
-                # observed device p99 (3x EWMA p99, floor 50 ms)
-                matcher.set_latency_budget_source(
-                    self.stats.suggested_latency_budget_s
-                )
-            try:
-                failpoints.check("pipeline.encode")
-                batch.state = self._begin_state(matcher, lines)
-            except Exception:  # noqa: BLE001 — encode failure → generic drain, no loss
-                log.exception(
-                    "pipeline encode stage failed; batch drains generically"
-                )
-                batch.state = None
+        with trace.span("encode", batch.trace_id,
+                        parent=batch.root_span.span_id) as sp:
+            # breaker OPEN: skip the split encode entirely — the generic
+            # drain re-parses inside consume_lines, which routes to the
+            # CPU fallback
+            if hasattr(matcher, "pipeline_begin") and not (
+                breaker is not None and breaker.state == OPEN
+            ):
+                if hasattr(matcher, "set_latency_budget_source"):
+                    # breaker-budget satellite: when
+                    # matcher_latency_budget_ms is unset the breaker
+                    # derives it from this pipeline's observed device p99
+                    # (3x EWMA p99, floor 50 ms)
+                    matcher.set_latency_budget_source(
+                        self.stats.suggested_latency_budget_s
+                    )
+                try:
+                    failpoints.check("pipeline.encode")
+                    batch.state = self._begin_state(matcher, lines, sp)
+                except Exception:  # noqa: BLE001 — encode failure → generic drain, no loss
+                    log.exception(
+                        "pipeline encode stage failed; batch drains "
+                        "generically"
+                    )
+                    sp.note("failed", True)
+                    batch.state = None
+            elif breaker is not None and breaker.state == OPEN:
+                sp.note("breaker", "open-skip")
         batch.t_encode_ms = (time.perf_counter() - t0) * 1e3
-        return batch
 
-    def _begin_state(self, matcher, lines: List[str]):
+    def _begin_state(self, matcher, lines: List[str], encode_span):
         """pipeline_begin, sharded across the encode-worker pool when the
         batch is big enough to pay for the fan-out.  Shard boundaries are
         contiguous row ranges; the matcher's merge reassembles columnar
@@ -405,10 +432,16 @@ class PipelineScheduler:
         output is byte-identical to the single-thread path.  A failing
         shard (worker death, the pipeline.encode_shard failpoint) fails
         only THIS batch — the exception propagates to _encode_batch's
-        generic-drain fallback and the pool itself survives."""
+        generic-drain fallback and the pool itself survives.
+
+        Each shard records an `encode-shard` child span of the encode
+        span (explicit ids — the pool threads have no ambient parent);
+        the single-thread path records one shard span covering the whole
+        parse so the trace shape is uniform either way."""
         now = self._now_fn()
         pool = self._encode_pool
         n = len(lines)
+        tid, parent = encode_span.trace_id, encode_span.span_id
         n_shards = 0
         if (
             pool is not None
@@ -417,14 +450,23 @@ class PipelineScheduler:
         ):
             n_shards = min(self.encode_workers, n // _MIN_SHARD_LINES)
         if n_shards < 2:
-            return matcher.pipeline_begin(lines, now)
+            with trace.span("encode-shard", tid, parent,
+                            args={"shard": 0, "shards": 1, "rows": n}):
+                return matcher.pipeline_begin(lines, now)
         bounds = [n * k // n_shards for k in range(n_shards + 1)]
         shard_ms = [0.0] * n_shards
 
         def run(k: int):
             t = time.perf_counter()
-            failpoints.check("pipeline.encode_shard")
-            out = matcher.encode_shard(lines[bounds[k] : bounds[k + 1]], now)
+            with trace.span(
+                "encode-shard", tid, parent,
+                args={"shard": k, "shards": n_shards,
+                      "rows": bounds[k + 1] - bounds[k]},
+            ):
+                failpoints.check("pipeline.encode_shard")
+                out = matcher.encode_shard(
+                    lines[bounds[k] : bounds[k + 1]], now
+                )
             shard_ms[k] = (time.perf_counter() - t) * 1e3
             return out
 
@@ -440,11 +482,7 @@ class PipelineScheduler:
         if err is not None:
             raise err
         wall_ms = (time.perf_counter() - t_fan) * 1e3
-        self.stats.note_encode_shards(
-            max(shard_ms),
-            sum(shard_ms) / max(1e-9, wall_ms * n_shards),
-            n_shards,
-        )
+        self.stats.note_encode_shards(shard_ms, wall_ms)
         return matcher.pipeline_begin_from_shards(lines, now, shards)
 
     # ---- device stage ----
@@ -479,12 +517,20 @@ class PipelineScheduler:
                 if batch.state is not None:
                     breaker = getattr(batch.matcher, "breaker", None)
                     if breaker is not None and not breaker.allow():
+                        trace.instant(
+                            "breaker-reroute", {"state": breaker.state},
+                            trace_id=batch.trace_id,
+                        )
                         batch.state = None  # generic drain → CPU fallback
                     else:
                         batch.t0_device = time.perf_counter()
                         try:
                             failpoints.check("pipeline.submit")
-                            batch.matcher.pipeline_submit(batch.state)
+                            with trace.span(
+                                "submit", batch.trace_id,
+                                parent=batch.root_span.span_id,
+                            ), trace.step_annotation(batch.trace_id):
+                                batch.matcher.pipeline_submit(batch.state)
                             # submit half of the device time; collect adds
                             # its half (NOT wall-from-submit: with depth-2
                             # overlap that would double-count the gap where
@@ -497,7 +543,7 @@ class PipelineScheduler:
                                 "pipeline submit stage failed; batch drains "
                                 "on the CPU reference path"
                             )
-                            self._device_failure(batch)
+                            self._device_failure(batch, "submit")
                         else:
                             pending.append(batch)
                             # keep ≤ 2 in flight: collect the older batch
@@ -517,13 +563,15 @@ class PipelineScheduler:
         t0 = time.perf_counter()
         try:
             failpoints.check("pipeline.collect")
-            batch.matcher.pipeline_collect(batch.state)
+            with trace.span("collect", batch.trace_id,
+                            parent=batch.root_span.span_id):
+                batch.matcher.pipeline_collect(batch.state)
         except Exception:  # noqa: BLE001 — device failure → fallback drain
             log.exception(
                 "pipeline collect stage failed; batch drains on the CPU "
                 "reference path"
             )
-            self._device_failure(batch)
+            self._device_failure(batch, "collect")
         else:
             batch.t_device_ms += (time.perf_counter() - t0) * 1e3
             self.stats.observe_device(batch.t_device_ms / 1e3)
@@ -532,7 +580,9 @@ class PipelineScheduler:
                 note(batch.t_device_ms / 1e3, ok=True)
         self._q_drain.put(batch)
 
-    def _device_failure(self, batch: _Batch) -> None:
+    def _device_failure(self, batch: _Batch, stage: str = "device") -> None:
+        trace.instant("device-failure", {"stage": stage},
+                      trace_id=batch.trace_id)
         # settle any two-phase chunks the failed batch already dispatched
         # (order turns + slot pins) before the generic rerun — idempotent
         abort = getattr(batch.matcher, "pipeline_abort", None)
@@ -560,69 +610,85 @@ class PipelineScheduler:
             n = len(batch.lines)
             results = None
             ok = True
-            try:
-                failpoints.check("pipeline.drain")
-                now = self._now_fn()
-                if batch.kind == "cmd":
-                    # command batch: dispatch each message in admission
-                    # order; a bad command loses itself, not the batch
-                    # (the handler owns parse errors, like the reference's
-                    # reader loop)
-                    for item in batch.lines:
-                        try:
-                            item.handler(item.raw)
-                        except Exception:  # noqa: BLE001
-                            log.exception("pipeline command dispatch failed")
-                    self.stats.note_commands(n)
-                elif batch.state is None:
-                    # generic path: full consume_lines semantics, including
-                    # the breaker's CPU-reference fallback — never a loss.
-                    # consume_lines_serial (when the matcher has it) keeps
-                    # the fused single-dispatch burst out of the drain
-                    # thread: its order turns belong to the two-phase
-                    # pipeline and an inline burst here would deadlock
-                    # behind in-flight later batches.
-                    consume = getattr(
-                        batch.matcher, "consume_lines_serial", None
-                    ) or batch.matcher.consume_lines
-                    results = consume(batch.lines, now)
-                    self.stats.note_batch(fallback=True)
-                else:
-                    results, n_stale = batch.matcher.pipeline_finish(
-                        batch.state, now
+            sp = trace.span("drain", batch.trace_id,
+                            parent=batch.root_span.span_id)
+            with sp:
+                try:
+                    failpoints.check("pipeline.drain")
+                    now = self._now_fn()
+                    if batch.kind == "cmd":
+                        # command batch: dispatch each message in admission
+                        # order; a bad command loses itself, not the batch
+                        # (the handler owns parse errors, like the
+                        # reference's reader loop)
+                        for item in batch.lines:
+                            try:
+                                item.handler(item.raw)
+                            except Exception:  # noqa: BLE001
+                                log.exception(
+                                    "pipeline command dispatch failed"
+                                )
+                        self.stats.note_commands(n)
+                    elif batch.state is None:
+                        # generic path: full consume_lines semantics,
+                        # including the breaker's CPU-reference fallback —
+                        # never a loss.  consume_lines_serial (when the
+                        # matcher has it) keeps the fused single-dispatch
+                        # burst out of the drain thread: its order turns
+                        # belong to the two-phase pipeline and an inline
+                        # burst here would deadlock behind in-flight later
+                        # batches.
+                        sp.note("fallback", "generic-drain")
+                        consume = getattr(
+                            batch.matcher, "consume_lines_serial", None
+                        ) or batch.matcher.consume_lines
+                        results = consume(batch.lines, now)
+                        self.stats.note_batch(fallback=True)
+                    else:
+                        results, n_stale = batch.matcher.pipeline_finish(
+                            batch.state, now
+                        )
+                        if n_stale:
+                            sp.note("stale_dropped", n_stale)
+                            self.stats.note_stale(n_stale)
+                        self.stats.note_batch(fallback=False)
+                except Exception:  # noqa: BLE001 — drain failure is counted, never silent
+                    ok = False
+                    log.exception(
+                        "pipeline drain stage failed; %d lines counted as "
+                        "shed", n
                     )
-                    if n_stale:
-                        self.stats.note_stale(n_stale)
-                    self.stats.note_batch(fallback=False)
-            except Exception:  # noqa: BLE001 — drain failure is counted, never silent
-                ok = False
-                log.exception(
-                    "pipeline drain stage failed; %d lines counted as shed", n
-                )
-                self.stats.note_drain_error(n)
-                if batch.state is not None:
-                    # free any two-phase order turns/pins the unfinished
-                    # batch still holds — a leaked turn would deadlock
-                    # every later fused drain
-                    abort = getattr(batch.matcher, "pipeline_abort", None)
-                    if abort is not None:
-                        try:
-                            abort(batch.state)
-                        except Exception:  # noqa: BLE001
-                            log.exception("pipeline abort failed")
-                if self._health is not None:
-                    self._health.degraded("drain failure; lines shed")
+                    self.stats.note_drain_error(n)
+                    if batch.state is not None:
+                        # free any two-phase order turns/pins the unfinished
+                        # batch still holds — a leaked turn would deadlock
+                        # every later fused drain
+                        abort = getattr(batch.matcher, "pipeline_abort", None)
+                        if abort is not None:
+                            try:
+                                abort(batch.state)
+                            except Exception:  # noqa: BLE001
+                                log.exception("pipeline abort failed")
+                    if self._health is not None:
+                        self._health.degraded("drain failure; lines shed")
             if ok:
                 self.stats.note_processed(n)
                 if self._health is not None:
                     self._health.ok()
             t_drain_ms = (time.perf_counter() - t0) * 1e3
+            batch.root_span.note("ok", ok)
+            trace.end(batch.root_span)
             if batch.kind != "cmd":
-                self._sizer.observe(n, {
+                stage_ms = {
                     "encode": batch.t_encode_ms,
                     "device": batch.t_device_ms,
                     "drain": t_drain_ms,
-                })
+                }
+                self._sizer.observe(n, stage_ms)
+                # labeled per-stage duration histograms for /metrics —
+                # recorded per batch regardless of tracing (the trace ring
+                # is the sampled view, the histogram the complete one)
+                self.stats.observe_stages(stage_ms)
             if self._on_results is not None and batch.kind != "cmd":
                 try:
                     self._on_results(batch.lines, results)
@@ -665,9 +731,20 @@ class PipelineScheduler:
     # ---- observability ----
 
     def snapshot(self) -> dict:
-        """Additive 29 s metrics-line keys (obs/metrics.py)."""
+        """Additive 29 s metrics-line keys (obs/metrics.py).  Resets the
+        interval windows — the line's single periodic consumer only."""
         out = self.stats.snapshot()
         out.update(self._sizer.snapshot())
+        return self._live_gauges(out)
+
+    def prom_snapshot(self) -> dict:
+        """Non-destructive view for /metrics (obs/exposition.py): totals,
+        EWMAs and live gauges; never steals the line's interval deltas."""
+        out = self.stats.peek()
+        out.update(self._sizer.snapshot())
+        return self._live_gauges(out)
+
+    def _live_gauges(self, out: dict) -> dict:
         with self._cond:
             out["PipelineBufferedLines"] = len(self._buf)
             out["PipelineInflightBatches"] = self._inflight

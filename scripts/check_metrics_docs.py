@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Cross-check README's documented metrics table against the exposition
+registry (banjax_tpu/obs/registry.py).
+
+The README "Observability" section carries a markdown table of every
+Prometheus family between the `<!-- metrics-table-start -->` /
+`<!-- metrics-table-end -->` markers.  This script fails (exit 1) when
+the table and the registry disagree — a renamed/added/dropped family
+must touch both, so dashboards never chase undocumented metrics.  Run
+with `--write` to regenerate the table from the registry in place.
+
+Wired into the test suite (tests/unit/test_exposition.py), so `pytest`
+is the CI gate; it also runs standalone:
+
+    python scripts/check_metrics_docs.py [--write] [README.md]
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _DIR)
+
+START = "<!-- metrics-table-start -->"
+END = "<!-- metrics-table-end -->"
+_ROW_RE = re.compile(r"^\|\s*`([^`]+)`\s*\|\s*(\S+)\s*\|\s*(.*?)\s*\|$")
+
+
+def registry_rows():
+    from banjax_tpu.obs.registry import FAMILIES
+
+    rows = []
+    for fam in FAMILIES:
+        if not fam.prom:
+            continue
+        labels = (
+            " (labels: " + ", ".join(f"`{l}`" for l in fam.labels) + ")"
+            if fam.labels else ""
+        )
+        rows.append((fam.prom, fam.kind, fam.help + labels))
+    return rows
+
+
+def render_table(rows) -> str:
+    lines = ["| family | type | help |", "|---|---|---|"]
+    for prom, kind, help_text in rows:
+        # pipes inside help would split the row
+        lines.append(f"| `{prom}` | {kind} | {help_text.replace('|', '/')} |")
+    return "\n".join(lines)
+
+
+def parse_readme_table(text: str):
+    try:
+        start = text.index(START) + len(START)
+        end = text.index(END)
+    except ValueError:
+        raise SystemExit(
+            f"README is missing the {START} / {END} markers"
+        ) from None
+    rows = []
+    for raw in text[start:end].strip().splitlines():
+        m = _ROW_RE.match(raw.strip())
+        if m:
+            rows.append((m.group(1), m.group(2), m.group(3)))
+    return rows
+
+
+def check(readme_path: str, write: bool = False) -> int:
+    with open(readme_path, encoding="utf-8") as f:
+        text = f.read()
+    want = registry_rows()
+    if write:
+        start = text.index(START) + len(START)
+        end = text.index(END)
+        new_text = text[:start] + "\n" + render_table(want) + "\n" + text[end:]
+        with open(readme_path, "w", encoding="utf-8") as f:
+            f.write(new_text)
+        print(f"wrote {len(want)} families into {readme_path}")
+        return 0
+    have = parse_readme_table(text)
+    have_names = {r[0] for r in have}
+    want_names = {r[0] for r in want}
+    problems = []
+    for missing in sorted(want_names - have_names):
+        problems.append(f"registry family not documented: {missing}")
+    for extra in sorted(have_names - want_names):
+        problems.append(f"documented family not in registry: {extra}")
+    want_by_name = {r[0]: r for r in want}
+    for name, kind, _help in have:
+        if name in want_by_name and kind != want_by_name[name][1]:
+            problems.append(
+                f"{name}: documented type {kind!r} != registry "
+                f"{want_by_name[name][1]!r}"
+            )
+    if problems:
+        for p in problems:
+            print(f"check_metrics_docs: {p}", file=sys.stderr)
+        print(
+            "check_metrics_docs: run `python scripts/check_metrics_docs.py "
+            "--write` to regenerate the README table from the registry",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"check_metrics_docs: {len(want)} families in sync")
+    return 0
+
+
+def main(argv) -> int:
+    write = "--write" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    readme = paths[0] if paths else os.path.join(_DIR, "README.md")
+    return check(readme, write=write)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

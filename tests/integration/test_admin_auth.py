@@ -1,0 +1,229 @@
+"""Admin-surface auth (ROADMAP PR 1 open item): /healthz, /metrics and
+/debug/trace gain constant-time bearer-token auth when the listener
+binds non-loopback and `admin_token` is set; the default loopback
+listener stays open, reference-style.  Covers the aiohttp layout's
+routes end-to-end and the fast layout's natively-served /healthz."""
+
+import asyncio
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+from banjax_tpu.decisions.rate_limit import (
+    FailedChallengeRateLimitStates,
+    RegexRateLimitStates,
+)
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.httpapi import server as server_mod
+from banjax_tpu.httpapi.fastserve import FastPathServer, _ParsedRequest
+from banjax_tpu.httpapi.server import admin_auth_ok, is_loopback_host
+from banjax_tpu.obs import trace
+from banjax_tpu.resilience.health import HealthRegistry
+from tests.mock_banner import MockBanner
+
+RULES_YAML = """
+regexes_with_rates:
+  - decision: nginx_block
+    rule: r
+    regex: 'GET .*'
+    interval: 5
+    hits_per_interval: 100
+"""
+
+TOKEN = "sekrit-scraper-token"
+ADMIN_ROUTES = ("/healthz", "/metrics", "/debug/trace")
+
+
+def _deps(cfg):
+    class Holder:
+        def get(self):
+            return cfg
+
+    health = HealthRegistry()
+    health.register("tailer").ok()
+    return server_mod.ServerDeps(
+        config_holder=Holder(),
+        static_lists=StaticDecisionLists(cfg),
+        dynamic_lists=DynamicDecisionLists(start_sweeper=False),
+        protected_paths=PasswordProtectedPaths(cfg),
+        regex_states=RegexRateLimitStates(),
+        failed_challenge_states=FailedChallengeRateLimitStates(),
+        banner=MockBanner(),
+        health=health,
+    )
+
+
+def test_loopback_host_predicate():
+    for host in ("", "127.0.0.1", "127.1.2.3", "::1", "[::1]", "localhost"):
+        assert is_loopback_host(host), host
+    for host in ("0.0.0.0", "10.0.0.5", "192.168.1.1", "::", "fe80::1"):
+        assert not is_loopback_host(host), host
+
+
+def test_admin_auth_matrix():
+    cfg = config_from_yaml_text(RULES_YAML)
+    # no token: open everywhere (bind-time warning is the guard)
+    assert admin_auth_ok(cfg, "0.0.0.0", "")
+    cfg.admin_token = TOKEN
+    # loopback stays open by default even with a token set
+    assert admin_auth_ok(cfg, "127.0.0.1", "")
+    # non-loopback: bearer required, constant-time match
+    assert not admin_auth_ok(cfg, "0.0.0.0", "")
+    assert not admin_auth_ok(cfg, "0.0.0.0", "Bearer wrong")
+    assert not admin_auth_ok(cfg, "0.0.0.0", TOKEN[:-1])
+    assert admin_auth_ok(cfg, "0.0.0.0", f"Bearer {TOKEN}")
+    # a raw token (no Bearer prefix) is accepted too — curl ergonomics
+    assert admin_auth_ok(cfg, "0.0.0.0", TOKEN)
+
+
+def _drive_app(cfg, listen_host, requests):
+    """Run each (path, headers) against a built app; returns statuses."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    deps = _deps(cfg)
+
+    async def go():
+        app = server_mod.build_app(deps, listen_host=listen_host)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            out = []
+            for path, headers in requests:
+                r = await client.get(path, headers=headers)
+                out.append(r.status)
+            return out
+        finally:
+            await client.close()
+
+    return asyncio.run(go())
+
+
+def test_aiohttp_admin_routes_open_on_loopback():
+    cfg = config_from_yaml_text(RULES_YAML)
+    cfg.admin_token = TOKEN
+    statuses = _drive_app(
+        cfg, "127.0.0.1", [(p, {}) for p in ADMIN_ROUTES]
+    )
+    assert statuses == [200, 200, 200]
+
+
+def test_aiohttp_admin_routes_gated_non_loopback():
+    cfg = config_from_yaml_text(RULES_YAML)
+    cfg.admin_token = TOKEN
+    bare = [(p, {}) for p in ADMIN_ROUTES]
+    wrong = [(p, {"Authorization": "Bearer nope"}) for p in ADMIN_ROUTES]
+    good = [(p, {"Authorization": f"Bearer {TOKEN}"}) for p in ADMIN_ROUTES]
+    statuses = _drive_app(cfg, "0.0.0.0", bare + wrong + good)
+    assert statuses[:3] == [401, 401, 401]
+    assert statuses[3:6] == [401, 401, 401]
+    assert statuses[6:] == [200, 200, 200]
+
+
+def test_aiohttp_non_admin_routes_stay_open_non_loopback():
+    """The gate covers ONLY the admin surface: /info and /auth_request
+    keep serving without a token (nginx calls them unauthenticated)."""
+    cfg = config_from_yaml_text(RULES_YAML)
+    cfg.admin_token = TOKEN
+    statuses = _drive_app(cfg, "0.0.0.0", [("/info", {})])
+    assert statuses == [200]
+
+
+def test_metrics_route_serves_parseable_exposition():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from banjax_tpu.obs.exposition import parse_text_format
+
+    cfg = config_from_yaml_text(RULES_YAML)
+    deps = _deps(cfg)
+
+    async def go():
+        app = server_mod.build_app(deps)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/metrics")
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            return await r.text()
+        finally:
+            await client.close()
+
+    text = asyncio.run(go())
+    fams = parse_text_format(text)
+    assert "banjax_health_status" in fams
+    assert "banjax_expiring_challenges" in fams
+
+
+def test_debug_trace_route_dumps_and_clears_ring():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    tracer = trace.configure(enabled=True, ring_size=64)
+    try:
+        tid = tracer.new_trace()
+        with tracer.span("drain", tid, parent=0):
+            pass
+        cfg = config_from_yaml_text(RULES_YAML)
+        deps = _deps(cfg)
+
+        async def go():
+            app = server_mod.build_app(deps)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/debug/trace", params={"clear": "1"})
+                assert r.status == 200
+                return await r.json()
+            finally:
+                await client.close()
+
+        payload = asyncio.run(go())
+        names = [e["name"] for e in payload["traceEvents"]
+                 if e["ph"] == "X"]
+        assert "drain" in names
+        assert payload["otherData"]["enabled"] is True
+        assert tracer.snapshot() == []  # ?clear=1 emptied the ring
+    finally:
+        trace.configure(enabled=False)
+
+
+class _FakeProto:
+    peer = "10.0.0.9"
+    transport = None
+
+    def __init__(self):
+        self.sent = b""
+
+    def write(self, data: bytes) -> None:
+        self.sent += data
+
+
+def _fast_request(path, headers=None):
+    return _ParsedRequest("GET", path, "", dict(headers or {}), b"",
+                          True, b"")
+
+
+@pytest.mark.parametrize(
+    "listen_host,auth,expect",
+    [
+        ("127.0.0.1", "", b"HTTP/1.1 200"),
+        ("0.0.0.0", "", b"HTTP/1.1 401"),
+        ("0.0.0.0", "Bearer nope", b"HTTP/1.1 401"),
+        ("0.0.0.0", f"Bearer {TOKEN}", b"HTTP/1.1 200"),
+    ],
+)
+def test_fastserve_native_healthz_auth(listen_host, auth, expect):
+    cfg = config_from_yaml_text(RULES_YAML)
+    cfg.admin_token = TOKEN
+    deps = _deps(cfg)
+    fps = FastPathServer(deps, proxy_sock="/nonexistent",
+                         listen_host=listen_host)
+    proto = _FakeProto()
+    headers = {"authorization": auth} if auth else {}
+    req = _fast_request("/healthz", headers)
+    assert fps.is_hot(req)  # healthz is served natively
+    fps.handle_hot(proto, req)
+    assert proto.sent.startswith(expect), proto.sent[:80]
+    if expect.endswith(b"401"):
+        assert b"WWW-Authenticate: Bearer" in proto.sent

@@ -1,0 +1,259 @@
+"""Exposition-schema stability: every key the 29 s line emits and every
+/metrics family is declared in the one registry (obs/registry.py), the
+reference's five keys stay byte-identical, /metrics parses under the
+strict text-format parser, and the README metrics table stays in
+lock-step with the registry (scripts/check_metrics_docs.py)."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.rate_limit import (
+    FailedChallengeRateLimitStates,
+    RegexRateLimitStates,
+)
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.matcher.runner import TpuMatcher
+from banjax_tpu.obs import registry
+from banjax_tpu.obs.exposition import (
+    ExpositionError,
+    parse_text_format,
+    render_prometheus,
+)
+from banjax_tpu.obs.metrics import write_metrics_line
+from banjax_tpu.pipeline import PipelineScheduler
+from banjax_tpu.resilience.health import HealthRegistry
+from tests.mock_banner import MockBanner
+
+RULES_YAML = """
+regexes_with_rates:
+  - decision: nginx_block
+    rule: r
+    regex: 'GET .*'
+    interval: 5
+    hits_per_interval: 100
+"""
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+@pytest.fixture(scope="module")
+def loaded_system():
+    """A matcher + drained pipeline with device windows on — the fullest
+    legitimately reachable snapshot surface."""
+    cfg = config_from_yaml_text(RULES_YAML)
+    cfg.matcher_device_windows = True
+    m = TpuMatcher(cfg, MockBanner(), StaticDecisionLists(cfg),
+                   RegexRateLimitStates())
+    now = time.time()
+    m.consume_lines(
+        [f"{now:.6f} 9.9.9.{i} GET h.com GET /x HTTP/1.1" for i in range(8)],
+        now,
+    )
+    sched = PipelineScheduler(lambda: m, now_fn=lambda: now)
+    sched.start()
+    sched.submit(
+        [f"{now:.6f} 8.8.8.{i % 40} GET h.com GET /y HTTP/1.1"
+         for i in range(256)]
+    )
+    assert sched.flush(60)
+    # sharded-encode stats so the per-worker gauges have data
+    sched.stats.note_encode_shards([4.0, 5.0], 5.5)
+    sched.stats.note_encode_shards([3.0, 6.0], 6.5)
+    health = HealthRegistry()
+    health.register("tailer").ok()
+    health.register("pipeline").degraded("test")
+    sup = types.SimpleNamespace(n_workers=2, respawn_count=1)
+    yield m, sched, health, sup
+    sched.stop()
+
+
+def _full_line(m, sched, health, sup) -> dict:
+    out = io.StringIO()
+    write_metrics_line(
+        out, DynamicDecisionLists(start_sweeper=False),
+        RegexRateLimitStates(), FailedChallengeRateLimitStates(),
+        m, sup, health, sched,
+    )
+    return json.loads(out.getvalue())
+
+
+def test_every_line_key_is_declared(loaded_system):
+    line = _full_line(*loaded_system)
+    undeclared = [k for k in line if not registry.is_declared_line_key(k)]
+    assert not undeclared, (
+        f"29s-line keys missing from obs/registry.py: {undeclared} — "
+        "declare them (name, type, help) or the dashboards chase ghosts"
+    )
+
+
+def test_reference_five_keys_byte_identical(loaded_system):
+    line = _full_line(*loaded_system)
+    for key in registry.REFERENCE_LINE_KEYS:
+        assert key in line, f"reference key {key} missing"
+    # the declared tuple itself is the reference's exact bytes
+    assert registry.REFERENCE_LINE_KEYS == (
+        "Time", "LenExpiringChallenges", "LenExpiringBlocks",
+        "LenIpToRegexStates", "LenFailedChallengeStates",
+    )
+
+
+def test_metrics_families_all_declared_and_parse(loaded_system):
+    m, sched, health, sup = loaded_system
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False), RegexRateLimitStates(),
+        FailedChallengeRateLimitStates(), matcher=m, pipeline=sched,
+        health=health, supervisor=sup,
+    )
+    fams = parse_text_format(text)  # strict: raises on any malformation
+    undeclared = [f for f in fams if f not in registry.PROM_FAMILIES]
+    assert not undeclared, f"/metrics families not in registry: {undeclared}"
+    # declared type matches emitted type
+    for name, ent in fams.items():
+        assert ent["type"] == registry.PROM_FAMILIES[name].kind, name
+    # core families present with plausible values
+    samples = {
+        s[0]: s[2] for ent in fams.values() for s in ent["samples"]
+        if not s[1]
+    }
+    assert samples["banjax_matcher_lines_total"] >= 8
+    assert samples["banjax_pipeline_processed_lines_total"] == 256
+    assert samples["banjax_health_status"] == 1  # degraded component
+
+
+def test_breaker_state_is_one_hot(loaded_system):
+    m, sched, health, sup = loaded_system
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False), RegexRateLimitStates(),
+        FailedChallengeRateLimitStates(), matcher=m,
+    )
+    fams = parse_text_format(text)
+    states = {
+        s[1]["state"]: s[2]
+        for s in fams["banjax_matcher_breaker_state"]["samples"]
+    }
+    assert set(states) == {"closed", "open", "half-open"}
+    assert sum(states.values()) == 1
+    assert states["closed"] == 1
+
+
+def test_per_worker_busy_fraction_and_skew(loaded_system):
+    m, sched, health, sup = loaded_system
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False), RegexRateLimitStates(),
+        FailedChallengeRateLimitStates(), pipeline=sched,
+    )
+    fams = parse_text_format(text)
+    workers = {
+        s[1]["worker"]: s[2]
+        for s in fams["banjax_encode_worker_busy_fraction"]["samples"]
+    }
+    assert set(workers) == {"0", "1"}
+    assert 0.0 < workers["0"] <= 1.0 and 0.0 < workers["1"] <= 1.0
+    # shard 1 is the consistently slower one in the fixture data
+    assert workers["1"] > workers["0"]
+    (skew,) = [
+        s[2] for s in fams["banjax_encode_shard_skew_max"]["samples"]
+    ]
+    assert skew > 1.0
+
+
+def test_scrape_does_not_steal_line_windows(loaded_system):
+    """peek()-based exposition must leave the 29 s line's interval
+    windows untouched: scrape between two lines, the line still sees the
+    full interval delta."""
+    m, sched, health, sup = loaded_system
+    now = time.time()
+    m.consume_lines(
+        [f"{now:.6f} 7.7.7.{i} GET h.com GET /z HTTP/1.1" for i in range(5)],
+        now,
+    )
+    for _ in range(3):  # scrapes between line snapshots
+        render_prometheus(
+            DynamicDecisionLists(start_sweeper=False), RegexRateLimitStates(),
+            FailedChallengeRateLimitStates(), matcher=m, pipeline=sched,
+        )
+    line = _full_line(m, sched, health, sup)
+    # the interval window still holds the 5 lines: scrapes didn't reset it
+    assert line["MatcherLinesPerSec"] > 0
+
+
+def test_parser_rejects_malformed_exposition():
+    bad_cases = [
+        "banjax_x 1\n",                      # sample without TYPE
+        "# TYPE banjax_x counter\nbanjax_x 1",  # missing trailing newline
+        "# TYPE banjax_x counter\nbanjax_x notanumber\n",
+        "# TYPE banjax_x counter\n# TYPE banjax_x counter\nbanjax_x 1\n",
+        '# TYPE banjax_x counter\nbanjax_x{bad-label="v"} 1\n',
+        "# TYPE banjax_x counter\nbanjax_x -3\n",  # negative counter
+    ]
+    for text in bad_cases:
+        with pytest.raises(ExpositionError):
+            parse_text_format(text)
+
+
+def test_parser_rejects_bad_histograms():
+    head = "# TYPE banjax_h histogram\n"
+    no_inf = head + (
+        'banjax_h_bucket{le="1.0"} 1\nbanjax_h_sum 1\nbanjax_h_count 1\n'
+    )
+    non_monotone = head + (
+        'banjax_h_bucket{le="1.0"} 5\nbanjax_h_bucket{le="+Inf"} 3\n'
+        "banjax_h_sum 1\nbanjax_h_count 3\n"
+    )
+    inf_ne_count = head + (
+        'banjax_h_bucket{le="1.0"} 1\nbanjax_h_bucket{le="+Inf"} 2\n'
+        "banjax_h_sum 1\nbanjax_h_count 3\n"
+    )
+    for text in (no_inf, non_monotone, inf_ne_count):
+        with pytest.raises(ExpositionError):
+            parse_text_format(text)
+
+
+def test_histogram_observations_land_in_buckets(loaded_system):
+    m, sched, health, sup = loaded_system
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False), RegexRateLimitStates(),
+        FailedChallengeRateLimitStates(), matcher=m, pipeline=sched,
+    )
+    fams = parse_text_format(text)
+    batch = fams["banjax_batch_latency_seconds"]["samples"]
+    count = [v for n, l, v in batch if n.endswith("_count")][0]
+    assert count >= 1  # consume_lines recorded batches
+    stages = {
+        s[1].get("stage") for s in
+        fams["banjax_stage_duration_seconds"]["samples"]
+        if s[0].endswith("_bucket")
+    }
+    assert {"encode", "device", "drain"} <= stages
+
+
+def test_check_metrics_docs_passes_and_catches_drift(tmp_path):
+    script = os.path.join(_REPO, "scripts", "check_metrics_docs.py")
+    r = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        cwd=_REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    # drift detection: drop one documented row -> nonzero exit
+    with open(os.path.join(_REPO, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    drifted = readme.replace("| `banjax_matcher_lines_total` |", "| `x` |", 1)
+    p = tmp_path / "README.md"
+    p.write_text(drifted, encoding="utf-8")
+    r = subprocess.run(
+        [sys.executable, script, str(p)], capture_output=True, text=True,
+        cwd=_REPO, timeout=120,
+    )
+    assert r.returncode == 1
+    assert "banjax_matcher_lines_total" in r.stderr

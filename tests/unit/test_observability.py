@@ -149,6 +149,66 @@ def test_pprof_endpoints_respond():
     asyncio.run(drive())
 
 
+def test_snapshot_window_reset_is_atomic_under_concurrency():
+    """Regression for the snapshot race window: window counters used to
+    be read and the eviction delta updated outside the stats lock, so a
+    concurrent snapshot could double-count or lose an interval delta.
+    Hammer record/snapshot from many threads and assert the deltas
+    telescope exactly (conservation) and totals never regress."""
+    import threading
+
+    from banjax_tpu.obs.stats import MatcherStats
+
+    class FakeWindows:
+        """Minimal device_windows surface with a racing eviction count."""
+
+        capacity = 64
+        occupancy = 10
+        grow_count = 0
+        eviction_count = 0
+
+        def __len__(self):
+            return 10
+
+    stats = MatcherStats()
+    windows = FakeWindows()
+    stop = threading.Event()
+    snapshots = []
+    snap_lock = threading.Lock()
+
+    def recorder():
+        while not stop.is_set():
+            stats.record_batch(10, 0.001)
+            stats.note_xfer(100, 50)
+            windows.eviction_count += 1  # single mutator thread
+
+    def snapshotter():
+        while not stop.is_set():
+            s = stats.snapshot(windows)
+            with snap_lock:
+                snapshots.append(s)
+
+    threads = [threading.Thread(target=recorder)] + [
+        threading.Thread(target=snapshotter) for _ in range(3)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(10)
+
+    final = stats.snapshot(windows)
+    snapshots.append(final)
+    # conservation: interval eviction deltas telescope to the final
+    # absolute count with nothing lost or double-counted
+    assert sum(
+        s["DeviceWindowsEvictionsPerInterval"] for s in snapshots
+    ) == final["DeviceWindowsEvictions"]
+    assert final["MatcherLinesTotal"] == 10 * final["MatcherBatchesTotal"]
+    assert final["MatcherH2dBytesTotal"] == 100 * final["MatcherBatchesTotal"]
+
+
 def test_supervisor_keys_are_additive():
     """Multi-worker serving health keys appear only when a supervisor is
     passed (the reference schema stays untouched otherwise)."""
