@@ -40,7 +40,8 @@ from banjax_tpu.ingest.kafka_io import KafkaReader, KafkaWriter
 from banjax_tpu.ingest.reports import report_status_message
 from banjax_tpu.ingest.tailer import LogTailer
 from banjax_tpu.matcher.cpu_ref import CpuMatcher
-from banjax_tpu.obs import trace
+from banjax_tpu.obs import flightrec as flightrec_mod
+from banjax_tpu.obs import provenance, trace
 from banjax_tpu.obs.metrics import MetricsReporter
 from banjax_tpu.resilience import failpoints
 from banjax_tpu.resilience.health import HealthRegistry
@@ -113,6 +114,14 @@ class BanjaxApp:
             jax_annotations=getattr(config, "trace_jax_annotations", False),
         )
 
+        # decision provenance ledger (obs/provenance.py): on by default —
+        # records fire per decision event, not per log line, and
+        # /decisions/explain answers "why is this IP banned?"
+        provenance.configure(
+            enabled=getattr(config, "provenance_enabled", True),
+            ring_size=getattr(config, "provenance_ring_size", 2048),
+        )
+
         self.regex_states = RegexRateLimitStates()
         self._supervisor = None  # multi-worker serving (httpapi/workers.py)
         n_http_workers = config.http_workers
@@ -182,6 +191,45 @@ class BanjaxApp:
             health=self.health.register("tailer", stale_after=60.0),
         )
 
+        # incident flight recorder (obs/flightrec.py): armed only with a
+        # flightrec_dir; installed as the module-level trigger target so
+        # the breaker/scheduler/SLO hooks stay one None-check when off
+        self.flightrec = None
+        if getattr(config, "flightrec_dir", ""):
+            from banjax_tpu.obs.flightrec import FlightRecorder
+
+            self.flightrec = FlightRecorder(
+                config.flightrec_dir,
+                min_interval_s=getattr(
+                    config, "flightrec_min_interval_s", 60.0
+                ),
+                keep=getattr(config, "flightrec_keep", 16),
+                provenance_tail=getattr(
+                    config, "flightrec_provenance_records", 256
+                ),
+                metrics_text_fn=self._render_metrics_text,
+                config_hash_fn=self._config_hash,
+                health=self.health,
+                slo_getter=lambda: self.slo,
+            )
+            flightrec_mod.install(self.flightrec)
+
+        # SLO burn-rate engine (obs/slo.py): evaluates 5 m / 1 h burn
+        # from non-destructive peeks; a breach transition captures an
+        # incident bundle (when the recorder is armed)
+        self.slo = None
+        if getattr(config, "slo_enabled", True):
+            from banjax_tpu.obs.slo import SloEngine
+
+            self.slo = SloEngine.from_config(
+                config,
+                matcher_getter=lambda: self._matcher,
+                pipeline_getter=lambda: self.pipeline,
+                on_breach=lambda name, burn: flightrec_mod.notify(
+                    f"slo-{name}", f"burn rates {burn}"
+                ),
+            )
+
         self.kafka_reader: Optional[KafkaReader] = None
         self.kafka_writer: Optional[KafkaWriter] = None
 
@@ -232,6 +280,30 @@ class BanjaxApp:
         if self._supervisor is not None:
             self._supervisor.broadcast_reload()
 
+    def _render_metrics_text(self) -> str:
+        """Full /metrics text for incident bundles — the same render the
+        route serves, from the same non-destructive views."""
+        from banjax_tpu.obs.exposition import render_prometheus
+
+        return render_prometheus(
+            self.dynamic_lists, RegexStatesView(self),
+            self.failed_challenge_states, matcher=self._matcher,
+            pipeline=self.pipeline, health=self.health,
+            supervisor=self._supervisor, slo=self.slo,
+            flightrec=self.flightrec,
+        )
+
+    def _config_hash(self) -> str:
+        """sha256 of the on-disk config file — ties an incident bundle
+        to the exact rules/limits that were live."""
+        import hashlib
+
+        try:
+            with open(self.config_holder.path, "rb") as f:
+                return hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            return ""
+
     def _current_matcher(self):
         # rebuilt on config change so rules hot-reload (regex_rate_limiter.go:59)
         cfg = self.config_holder.get()
@@ -263,6 +335,8 @@ class BanjaxApp:
         config = self.config_holder.get()
         if self.pipeline is not None:
             self.pipeline.start()
+        if self.slo is not None:
+            self.slo.start(getattr(config, "slo_sample_seconds", 15.0))
         self.tailer.start()
 
         # kafka→pipeline routing: command messages share the pipeline's
@@ -324,6 +398,8 @@ class BanjaxApp:
             matcher_getter=lambda: self._matcher,
             pipeline_getter=lambda: self.pipeline,
             supervisor_getter=lambda: self._supervisor,
+            slo_getter=lambda: self.slo,
+            flightrec_getter=lambda: self.flightrec,
         )
 
     async def _serve(self, install_signal_handlers: bool) -> None:
@@ -393,6 +469,12 @@ class BanjaxApp:
         if self.pipeline is not None:
             # tailer first (no new admissions), then drain what's in flight
             self.pipeline.stop()
+        if self.slo is not None:
+            self.slo.stop()
+        if self.flightrec is not None:
+            # uninstall the module-level trigger target so a later app in
+            # the same process (in-process tests) starts clean
+            flightrec_mod.install(None)
         self.metrics.stop()
         # release the shm table only AFTER the metrics loop is stopped —
         # a late tick calling len(failed_challenge_states) on a released

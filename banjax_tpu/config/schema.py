@@ -286,6 +286,40 @@ class Config:
     # 127.0.0.1.  Binding non-loopback without admin_token logs a
     # warning (the whole admin surface would be open to the network).
     http_listen_host: str = ""
+    # --- decision provenance / SLO engine / flight recorder (obs/) ---
+    # provenance ledger (obs/provenance.py): every Decision insertion
+    # (static/ua list hit, fired rate-limit ban, Kafka command,
+    # challenge failure, dynamic-list expiry) lands in a per-source
+    # ring, queryable via GET /decisions/explain?ip=…  On by default:
+    # records fire only on decision events, not per log line (bench.py
+    # --provenance-overhead banks the measured on/off delta).
+    provenance_enabled: bool = True
+    provenance_ring_size: int = 2048
+    # SLO burn-rate engine (obs/slo.py): multi-window (5 m / 1 h)
+    # error-budget burn from non-destructive counter/histogram peeks,
+    # exposed as banjax_slo_burn_rate{slo,window} / banjax_slo_breached
+    slo_enabled: bool = True
+    slo_sample_seconds: float = 15.0  # 0 = no background sampling thread
+    # fraction of matcher batches that must land inside
+    # pipeline_latency_budget_ms
+    slo_batch_latency_target: float = 0.99
+    # max acceptable (shed + drain-error) lines per admitted line
+    slo_shed_ratio_max: float = 0.001
+    # max acceptable drain-staleness drops per processed line
+    slo_stale_ratio_max: float = 0.001
+    # max acceptable breaker-OPEN seconds per wall second
+    slo_breaker_open_ratio_max: float = 0.01
+    # max acceptable matcher latency-budget trips per batch
+    slo_budget_trip_ratio_max: float = 0.01
+    # incident flight recorder (obs/flightrec.py): on any SLO breach,
+    # breaker trip, or shed burst, capture a tar-friendly bundle
+    # (trace.json / metrics.prom / provenance.json / meta.json) into
+    # this directory; empty = disabled.  GET /debug/incidents lists and
+    # serves bundles.
+    flightrec_dir: str = ""
+    flightrec_min_interval_s: float = 60.0  # capture debounce
+    flightrec_keep: int = 16  # newest bundles retained
+    flightrec_provenance_records: int = 256  # ledger tail per bundle
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -332,6 +366,13 @@ _SCALAR_KEYS = {
     "trace_enabled": bool, "trace_ring_size": int,
     "trace_jax_annotations": bool, "admin_token": str,
     "http_listen_host": str,
+    "provenance_enabled": bool, "provenance_ring_size": int,
+    "slo_enabled": bool, "slo_sample_seconds": float,
+    "slo_batch_latency_target": float, "slo_shed_ratio_max": float,
+    "slo_stale_ratio_max": float, "slo_breaker_open_ratio_max": float,
+    "slo_budget_trip_ratio_max": float,
+    "flightrec_dir": str, "flightrec_min_interval_s": float,
+    "flightrec_keep": int, "flightrec_provenance_records": int,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -484,6 +525,34 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
         raise ValueError(
             "config key trace_ring_size: expected >= 1, got "
             f"{cfg.trace_ring_size}"
+        )
+    if cfg.provenance_ring_size < 1:
+        raise ValueError(
+            "config key provenance_ring_size: expected >= 1, got "
+            f"{cfg.provenance_ring_size}"
+        )
+    if not 0.0 < cfg.slo_batch_latency_target < 1.0:
+        raise ValueError(
+            "config key slo_batch_latency_target: expected a fraction in "
+            f"(0, 1), got {cfg.slo_batch_latency_target}"
+        )
+    for _k in ("slo_shed_ratio_max", "slo_stale_ratio_max",
+               "slo_breaker_open_ratio_max", "slo_budget_trip_ratio_max"):
+        if getattr(cfg, _k) <= 0:
+            raise ValueError(
+                f"config key {_k}: expected positive, got {getattr(cfg, _k)}"
+            )
+    if cfg.slo_sample_seconds < 0 or cfg.flightrec_min_interval_s < 0:
+        raise ValueError(
+            "config keys slo_sample_seconds/flightrec_min_interval_s: "
+            f"expected non-negative, got {cfg.slo_sample_seconds}/"
+            f"{cfg.flightrec_min_interval_s}"
+        )
+    if cfg.flightrec_keep < 1 or cfg.flightrec_provenance_records < 1:
+        raise ValueError(
+            "config keys flightrec_keep/flightrec_provenance_records: "
+            f"expected >= 1, got {cfg.flightrec_keep}/"
+            f"{cfg.flightrec_provenance_records}"
         )
 
     return cfg

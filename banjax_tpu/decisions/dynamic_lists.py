@@ -19,6 +19,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from banjax_tpu.decisions.model import Decision
+from banjax_tpu.obs import provenance
 
 SWEEP_INTERVAL_SECONDS = 9  # decision.go:396
 
@@ -107,15 +108,30 @@ class DynamicDecisionLists:
                 if ed is not None:
                     if now - ed.expires > 0:
                         del self._by_session_id[session_id]
+                        provenance.record(
+                            provenance.SOURCE_EXPIRY, ed.ip_address,
+                            ed.decision, rule="session-lazy",
+                        )
                         return ed, False
                     return ed, True
             ed = self._by_ip.get(client_ip)
             if ed is not None:
                 if now - ed.expires > 0:
                     del self._by_ip[client_ip]
+                    provenance.record(
+                        provenance.SOURCE_EXPIRY, client_ip, ed.decision,
+                        rule="lazy",
+                    )
                     return ed, False
                 return ed, True
         return None, False
+
+    def peek(self, ip: str) -> Optional[ExpiringDecision]:
+        """Read-only lookup for introspection (/decisions/explain): no
+        lazy-expiry side effect — an admin read must not mutate the list
+        (check() deletes expired entries and records their expiry)."""
+        with self._lock:
+            return self._by_ip.get(ip)
 
     def check_by_domain(self, domain: str) -> List[BannedEntry]:
         """decision.go:502-530 — entries with severity ≥ Challenge for a domain."""
@@ -158,7 +174,10 @@ class DynamicDecisionLists:
         now = time.time()
         with self._lock:
             for ip in [ip for ip, ed in self._by_ip.items() if now - ed.expires > 0]:
-                del self._by_ip[ip]
+                ed = self._by_ip.pop(ip)
+                provenance.record(
+                    provenance.SOURCE_EXPIRY, ip, ed.decision, rule="sweep"
+                )
 
     def _sweep_loop(self) -> None:
         while not self._stop.wait(SWEEP_INTERVAL_SECONDS):

@@ -66,6 +66,7 @@ from banjax_tpu.httpapi.rewrite import (
     apply_args_to_sha_inv_page,
 )
 from banjax_tpu.ingest.reports import report_passed_failed_banned_message
+from banjax_tpu.obs import provenance
 
 log = logging.getLogger(__name__)
 
@@ -435,6 +436,11 @@ def too_many_failed_challenges(
             config.too_many_failed_challenges_threshold, req.client_user_agent,
             decision_type, req.method,
         )
+        provenance.record(
+            provenance.SOURCE_CHALLENGE, req.client_ip, decision_type,
+            rule=f"failed challenge {challenge_type}",
+            hits=config.too_many_failed_challenges_threshold,
+        )
     return result
 
 
@@ -651,6 +657,7 @@ def decision_for_nginx(
             DecisionListResult.PER_SITE_UA_ACCESS_GRANTED,
             DecisionListResult.PER_SITE_UA_CHALLENGE,
             DecisionListResult.PER_SITE_UA_BLOCK,
+            prov_source=provenance.SOURCE_UA,
         )
         if outcome is not None:
             return outcome, result
@@ -675,6 +682,7 @@ def decision_for_nginx(
             DecisionListResult.GLOBAL_UA_ACCESS_GRANTED,
             DecisionListResult.GLOBAL_UA_CHALLENGE,
             DecisionListResult.GLOBAL_UA_BLOCK,
+            prov_source=provenance.SOURCE_UA,
         )
         if outcome is not None:
             return outcome, result
@@ -748,11 +756,19 @@ def _apply_static_decision(
     granted: DecisionListResult,
     challenge: DecisionListResult,
     block: DecisionListResult,
+    prov_source: str = provenance.SOURCE_STATIC,
 ) -> Optional[Response]:
-    """The shared Allow/Challenge/Block arm for chain steps 3-6."""
+    """The shared Allow/Challenge/Block arm for chain steps 3-6.
+
+    Every acted-on list hit lands in the provenance ledger (the rule
+    field carries the chain arm, e.g. "PerSiteBlock") — static and UA
+    list hits are two of the four decision sources the reference
+    attributes bans to (PAPER.md §0)."""
     config = state.config
     if decision == Decision.ALLOW:
         result.decision_list_result = granted
+        provenance.record(prov_source, req.client_ip, decision,
+                          rule=str(granted))
         return access_granted(config, req, str(granted))
     if decision == Decision.CHALLENGE:
         resp, sha_result, rate_result = send_or_validate_sha_challenge(
@@ -761,8 +777,12 @@ def _apply_static_decision(
         result.decision_list_result = challenge
         result.sha_challenge_result = sha_result
         result.too_many_failed_challenges_result = rate_result
+        provenance.record(prov_source, req.client_ip, decision,
+                          rule=str(challenge))
         return resp
     if decision in (Decision.NGINX_BLOCK, Decision.IPTABLES_BLOCK):
         result.decision_list_result = block
+        provenance.record(prov_source, req.client_ip, decision,
+                          rule=str(block))
         return access_denied(config, req, str(block))
     return None

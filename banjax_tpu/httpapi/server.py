@@ -66,7 +66,8 @@ def is_loopback_host(host: str) -> bool:
 
 
 def admin_auth_ok(config, listen_host: str, authorization: str) -> bool:
-    """Gate for the admin surface (/healthz, /metrics, /debug/trace).
+    """Gate for the admin surface (/healthz, /metrics, /debug/trace,
+    /decisions/explain, /debug/incidents).
 
     Open on a loopback listener (the reference's 127.0.0.1:8081 posture —
     local operators and sidecar scrapers need no secret) or when no
@@ -100,6 +101,10 @@ class ServerDeps:
     matcher_getter: Optional[Callable[[], object]] = None
     pipeline_getter: Optional[Callable[[], object]] = None
     supervisor_getter: Optional[Callable[[], object]] = None
+    # SLO burn-rate engine (obs/slo.py) and incident flight recorder
+    # (obs/flightrec.py) — both optional, both primary-owned
+    slo_getter: Optional[Callable[[], object]] = None
+    flightrec_getter: Optional[Callable[[], object]] = None
 
 
 _STANDALONE_KEY = "banjax_standalone_hdrs"
@@ -501,6 +506,10 @@ def build_app(deps: ServerDeps,
             supervisor=(
                 deps.supervisor_getter() if deps.supervisor_getter else None
             ),
+            slo=deps.slo_getter() if deps.slo_getter else None,
+            flightrec=(
+                deps.flightrec_getter() if deps.flightrec_getter else None
+            ),
         )
         return web.Response(
             text=text,
@@ -516,11 +525,79 @@ def build_app(deps: ServerDeps,
         from banjax_tpu.obs import trace as trace_mod
 
         tracer = trace_mod.get_tracer()
-        payload = tracer.export_chrome()
+        # snapshot+clear is ONE lock section inside the tracer: a span
+        # recorded while this dump renders is either in the dump or kept
+        # for the next one — never silently dropped by the clear
+        payload = tracer.export_chrome(
+            clear=request.query.get("clear") in ("1", "true")
+        )
         payload["otherData"]["enabled"] = tracer.enabled
-        if request.query.get("clear") in ("1", "true"):
-            tracer.clear()
         return web.json_response(payload)
+
+    async def decisions_explain_route(request: web.Request) -> web.Response:
+        """Decision provenance for one IP: every ledger record across
+        the six sources, plus the live dynamic-list entry (read without
+        the lazy-expiry side effect — an admin read must not mutate)."""
+        denied = _admin_denied(request)
+        if denied is not None:
+            return denied
+        ip = request.query.get("ip", "")
+        if not ip:
+            return web.json_response(
+                {"error": "ip query param is required"}, status=400
+            )
+        from banjax_tpu.obs import provenance as provenance_mod
+
+        ledger = provenance_mod.get_ledger()
+        records = ledger.explain(ip)
+        active = None
+        peek = getattr(deps.dynamic_lists, "peek", None)
+        if peek is not None:
+            ed = peek(ip)
+            if ed is not None:
+                active = {
+                    "decision": str(ed.decision),
+                    "expires": ed.expires,
+                    "domain": ed.domain,
+                    "from_baskerville": ed.from_baskerville,
+                }
+        return web.json_response({
+            "ip": ip,
+            "ledger_enabled": ledger.enabled,
+            "records": records,
+            "active_decision": active,
+        })
+
+    async def debug_incidents_route(request: web.Request) -> web.Response:
+        """Flight-recorder surface: list bundles, fetch a manifest, or
+        fetch one bundle file (?name=…&file=…)."""
+        denied = _admin_denied(request)
+        if denied is not None:
+            return denied
+        rec = deps.flightrec_getter() if deps.flightrec_getter else None
+        if rec is None:
+            return web.json_response({"enabled": False, "incidents": []})
+        name = request.query.get("name", "")
+        fname = request.query.get("file", "")
+        if name and fname:
+            data = rec.read_file(name, fname)
+            if data is None:
+                return web.json_response({"error": "not found"}, status=404)
+            ctype = (
+                "application/json" if fname.endswith(".json")
+                else "text/plain"
+            )
+            return web.Response(body=data, content_type=ctype)
+        if name:
+            for entry in rec.list_incidents():
+                if entry["name"] == name:
+                    return web.json_response(entry)
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({
+            "enabled": True,
+            "directory": rec.directory,
+            "incidents": rec.list_incidents(),
+        })
 
     app.router.add_route("*", "/auth_request", auth_request)
     app.router.add_get("/info", info)
@@ -531,6 +608,8 @@ def build_app(deps: ServerDeps,
         app.router.add_get("/healthz", healthz)
         app.router.add_get("/metrics", metrics_route)
         app.router.add_get("/debug/trace", debug_trace_route)
+        app.router.add_get("/decisions/explain", decisions_explain_route)
+        app.router.add_get("/debug/incidents", debug_incidents_route)
         app.router.add_get("/decision_lists", decision_lists_route)
         app.router.add_get("/rate_limit_states", rate_limit_states_route)
         app.router.add_get("/is_banned", is_banned)
@@ -689,8 +768,9 @@ async def run_http_server(
     ):
         log.warning(
             "http listener binds non-loopback %s with no admin_token: the "
-            "admin surface (/healthz /metrics /debug/trace) is open to the "
-            "network", listen_host,
+            "admin surface (/healthz /metrics /debug/trace "
+            "/decisions/explain /debug/incidents) is open to the network",
+            listen_host,
         )
 
     if not fast:

@@ -56,6 +56,17 @@ def main(argv: Optional[list] = None) -> int:
     )
     config = config_holder.get()
 
+    # the worker's decision chain records list-hit/challenge provenance
+    # into its process-local ledger; configure it to the same shape as
+    # the primary's (the authoritative inserts are re-ledgered there via
+    # the control plane — the primary serves /decisions/explain)
+    from banjax_tpu.obs import provenance
+
+    provenance.configure(
+        enabled=getattr(config, "provenance_enabled", True),
+        ring_size=getattr(config, "provenance_ring_size", 2048),
+    )
+
     static_lists = StaticDecisionLists(config)
     protected_paths = PasswordProtectedPaths(config)
     replica = DynamicDecisionLists()

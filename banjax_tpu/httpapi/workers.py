@@ -61,10 +61,13 @@ COLD_ROUTES = (
     "/banned",
     "/unban",
     "/healthz",
-    # observability surface: the metrics registries and the trace ring
-    # live in the primary (the pipeline/matcher run there)
+    # observability surface: the metrics registries, the trace ring, the
+    # provenance ledger and the flight recorder live in the primary (the
+    # pipeline/matcher run there)
     "/metrics",
     "/debug/trace",
+    "/decisions/explain",
+    "/debug/incidents",
 )
 
 
@@ -142,6 +145,15 @@ class ControlPlane:
             app.banner.ban_or_challenge_ip(
                 app.config_holder.get(), msg["ip"],
                 Decision(int(msg["decision"])), msg["domain"],
+            )
+            # the worker recorded the chain-side provenance in ITS
+            # process; the primary (which owns /decisions/explain)
+            # ledgers the authoritative insert it just applied
+            from banjax_tpu.obs import provenance
+
+            provenance.record(
+                provenance.SOURCE_CHALLENGE, msg["ip"],
+                Decision(int(msg["decision"])), rule="worker-forwarded",
             )
         elif op == "fc_log":
             app.banner.log_failed_challenge_ban(

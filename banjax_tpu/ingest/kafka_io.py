@@ -38,6 +38,7 @@ from banjax_tpu.config.schema import Config
 from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
 from banjax_tpu.decisions.model import Decision
 from banjax_tpu.ingest.reports import get_message_queue
+from banjax_tpu.obs import provenance
 from banjax_tpu.resilience import failpoints
 from banjax_tpu.resilience.backoff import Backoff
 from banjax_tpu.resilience.health import ComponentHealth
@@ -191,6 +192,10 @@ def _handle_ip_command(
         True,  # from baskerville
         command.get("host", ""),
     )
+    provenance.record(
+        provenance.SOURCE_KAFKA, value, decision,
+        rule=command.get("Name", ""),
+    )
 
 
 def _handle_session_command(
@@ -211,6 +216,10 @@ def _handle_session_command(
         decision,
         True,
         command.get("host", ""),
+    )
+    provenance.record(
+        provenance.SOURCE_KAFKA, command.get("Value", ""), decision,
+        rule=command.get("Name", ""),
     )
 
 

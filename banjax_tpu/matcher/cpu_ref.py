@@ -28,6 +28,7 @@ from banjax_tpu.decisions.static_lists import StaticDecisionLists
 from banjax_tpu.effectors.banner import BannerInterface
 from banjax_tpu.matcher.api import ConsumeLineResult, Matcher, RuleResult
 from banjax_tpu.matcher.encode import parse_line
+from banjax_tpu.obs import provenance
 
 log = logging.getLogger(__name__)
 
@@ -116,6 +117,10 @@ class CpuMatcher(Matcher):
             )
             self.banner.log_regex_ban(
                 self.config, timestamp_ns / 1e9, ip_string, rule.rule, rest, rule.decision
+            )
+            provenance.record(
+                provenance.SOURCE_RATE_LIMIT, ip_string, rule.decision,
+                rule=rule.rule, hits=rule.hits_per_interval + 1,
             )
 
         return result

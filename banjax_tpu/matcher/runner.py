@@ -50,7 +50,7 @@ from banjax_tpu.matcher.workset import (
     unique_spans,
 )
 from banjax_tpu.matcher.rulec import compile_rules
-from banjax_tpu.obs import trace
+from banjax_tpu.obs import flightrec, provenance, trace
 from banjax_tpu.resilience import failpoints
 from banjax_tpu.resilience.breaker import CLOSED, CircuitBreaker
 from banjax_tpu.resilience.health import HealthRegistry, HealthStatus
@@ -87,9 +87,9 @@ class TpuMatcher(Matcher):
             name="matcher-device",
             # breaker trips land in the trace ring as instant events so a
             # Perfetto view shows WHEN degraded mode started relative to
-            # the batch spans around it
-            on_trip=lambda name: trace.instant("breaker-trip",
-                                               {"breaker": name}),
+            # the batch spans around it, and arm the incident flight
+            # recorder (debounced; no-op when none is installed)
+            on_trip=self._on_breaker_trip,
         )
         self._latency_budget_s = (
             getattr(config, "matcher_latency_budget_ms", 0.0) or 0.0
@@ -99,6 +99,10 @@ class TpuMatcher(Matcher):
         # breaker-tuning item; obs/stats.py suggested_latency_budget_s)
         self._latency_budget_source = None
         self.fallback_batches = 0  # batches served by the CPU fallback
+        # latency-budget breaches counted as breaker failures — the
+        # observable validation of the derived budget the ROADMAP carried
+        # (banjax_matcher_budget_trips_total; feeds the SLO engine)
+        self.budget_trips = 0
         # two-phase fused chunks committed through the streaming pipeline
         # (match dispatched at submit, window commit at drain) and how
         # often one fell back to the classic replay mid-pipeline
@@ -420,6 +424,7 @@ class TpuMatcher(Matcher):
                 return self._fallback_consume(lines, now_unix)
             budget = self.effective_latency_budget_s()
             if budget and time.perf_counter() - t0 > budget:
+                self.budget_trips += 1
                 self.breaker.record_failure()
             else:
                 self.breaker.record_success()
@@ -467,10 +472,15 @@ class TpuMatcher(Matcher):
         else:
             budget = self.effective_latency_budget_s()
             if budget and elapsed_s > budget:
+                self.budget_trips += 1
                 self.breaker.record_failure()
             else:
                 self.breaker.record_success()
         self._note_health()
+
+    def _on_breaker_trip(self, name: str) -> None:
+        trace.instant("breaker-trip", {"breaker": name})
+        flightrec.notify("breaker-trip", name)
 
     def _fallback_matcher(self):
         if self._cpu_fallback is None:
@@ -1506,6 +1516,14 @@ class TpuMatcher(Matcher):
                             self.config, p.timestamp_ns / 1e9, p.ip,
                             rule.rule, p.rest, rule.decision,
                         )
+                        # fixed-window semantics: the ban fires the hit
+                        # after the threshold; the ambient drain span
+                        # supplies the admitting batch's trace id
+                        provenance.record(
+                            provenance.SOURCE_RATE_LIMIT, p.ip,
+                            rule.decision, rule=rule.rule, rule_index=idx,
+                            hits=rule.hits_per_interval + 1,
+                        )
                     results[i].rule_results.append(result)
             except Exception:  # noqa: BLE001 — a failing effector loses one line, not the batch
                 log.exception("error applying rules to log line")
@@ -1744,6 +1762,10 @@ class TpuMatcher(Matcher):
             self.banner.ban_or_challenge_ip(self.config, p.ip, rule.decision, p.host)
             self.banner.log_regex_ban(
                 self.config, p.timestamp_ns / 1e9, p.ip, rule.rule, p.rest, rule.decision
+            )
+            provenance.record(
+                provenance.SOURCE_RATE_LIMIT, p.ip, rule.decision,
+                rule=rule.rule, hits=rule.hits_per_interval + 1,
             )
         return result
 

@@ -103,6 +103,8 @@ def render_prometheus(
     pipeline=None,
     health=None,
     supervisor=None,
+    slo=None,
+    flightrec=None,
 ) -> str:
     """Render the full /metrics payload.  Args mirror
     obs.metrics.write_metrics_line — same sources, non-destructive
@@ -156,6 +158,33 @@ def render_prometheus(
             fam = registry.PROM_FAMILIES["banjax_encode_worker_busy_fraction"]
             for k, frac in enumerate(fracs):
                 w.sample(fam, frac, {"worker": str(k)})
+
+    # decision provenance: per-(source, decision) insert totals from the
+    # process ledger (obs/provenance.py) — the attribution counter family
+    from banjax_tpu.obs import provenance as provenance_mod
+
+    prov_counters = provenance_mod.get_ledger().counters()
+    if prov_counters:
+        fam = registry.PROM_FAMILIES["banjax_decision_inserts_total"]
+        for (source, decision), v in sorted(prov_counters.items()):
+            w.sample(fam, v, {"source": source, "decision": decision})
+
+    # SLO burn rates + the one-hot breach gauge (obs/slo.py)
+    if slo is not None:
+        burn_fam = registry.PROM_FAMILIES["banjax_slo_burn_rate"]
+        for slo_name, windows in sorted(slo.burn_rates().items()):
+            for window, rate in sorted(windows.items()):
+                w.sample(burn_fam, rate, {"slo": slo_name, "window": window})
+        breach_fam = registry.PROM_FAMILIES["banjax_slo_breached"]
+        for slo_name, hit in sorted(slo.breached().items()):
+            w.sample(breach_fam, 1 if hit else 0, {"slo": slo_name})
+
+    # incident flight recorder (obs/flightrec.py)
+    if flightrec is not None:
+        w.sample(
+            registry.PROM_FAMILIES["banjax_flightrec_incidents_total"],
+            flightrec.incident_count,
+        )
 
     # component health: aggregate + one labeled gauge per component
     if health is not None:

@@ -169,6 +169,24 @@ FAMILIES: List[Family] = [
     Family(COUNTER, "batches served by the CPU reference matcher (degraded)",
            line_key="MatcherCpuFallbackBatches",
            prom="banjax_matcher_cpu_fallback_batches_total"),
+    Family(COUNTER, "matcher latency-budget breaches counted as breaker "
+           "failures (validates the derived budget)",
+           line_key="MatcherBudgetTrips",
+           prom="banjax_matcher_budget_trips_total"),
+    # ---- decision provenance / SLO / flight recorder ----
+    Family(COUNTER, "decision insertions recorded by the provenance "
+           "ledger (obs/provenance.py; /decisions/explain)",
+           prom="banjax_decision_inserts_total",
+           labels=("source", "decision")),
+    Family(GAUGE, "SLO error-budget burn rate over the labeled window "
+           "(1.0 = consuming the budget exactly at the sustainable rate)",
+           prom="banjax_slo_burn_rate", labels=("slo", "window")),
+    Family(GAUGE, "1 when the SLO burns >= 1.0 on every evaluated window "
+           "(one-hot by slo label)",
+           prom="banjax_slo_breached", labels=("slo",)),
+    Family(COUNTER, "incident bundles captured by the flight recorder "
+           "(obs/flightrec.py; /debug/incidents)",
+           prom="banjax_flightrec_incidents_total"),
     # ---- pipeline scheduler ----
     Family(COUNTER, "lines+commands admitted into the pipeline",
            line_key="PipelineAdmittedLines",

@@ -174,6 +174,12 @@ class MatcherStats:
                 out["MatcherCpuFallbackBatches"] = getattr(
                     matcher, "fallback_batches", 0
                 )
+                # latency-budget breaches — distinct from device errors
+                # in the trip accounting, so the ROADMAP's "derived
+                # budget never validated" note has an observable counter
+                out["MatcherBudgetTrips"] = getattr(
+                    matcher, "budget_trips", 0
+                )
         return out
 
     def snapshot(self, device_windows=None, matcher=None) -> Dict[str, object]:

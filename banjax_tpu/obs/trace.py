@@ -214,10 +214,25 @@ class Tracer:
             self._ring[self._n % self.ring_size] = rec
             self._n += 1
 
+    def current_trace_id(self) -> int:
+        """Trace id of the thread's ambient span (0 when none / off) —
+        lets passive observers (the provenance ledger) attribute an
+        effect to the admitting batch without any id plumbing."""
+        if not self.enabled:
+            return 0
+        stack = self._ambient.__dict__.get("stack")
+        return stack[-1].trace_id if stack else 0
+
     # ---- export ----
 
-    def snapshot(self) -> List[dict]:
-        """Ring contents oldest-first as plain dicts (tests, debugging)."""
+    def snapshot(self, clear: bool = False) -> List[dict]:
+        """Ring contents oldest-first as plain dicts (tests, debugging).
+
+        ``clear=True`` snapshots AND empties the ring in one lock
+        section: a span recorded between a separate dump and clear would
+        be silently dropped, and two concurrent clearing dumps could
+        each report the same span — /debug/trace?clear=1 uses this
+        atomic form (tests/unit/test_trace.py hammers it)."""
         with self._lock:
             n = self._n
             if n <= self.ring_size:
@@ -225,6 +240,9 @@ class Tracer:
             else:
                 cut = n % self.ring_size
                 recs = self._ring[cut:] + self._ring[:cut]
+            if clear:
+                self._ring = [None] * self.ring_size
+                self._n = 0
         out = []
         for r in recs:
             if r is None:
@@ -237,14 +255,17 @@ class Tracer:
             })
         return out
 
-    def export_chrome(self) -> dict:
+    def export_chrome(self, clear: bool = False) -> dict:
         """Chrome trace_event JSON (Perfetto / chrome://tracing).
 
         Complete ('X') events for spans, instant ('i') events for
         annotations; one virtual pid, one tid per recorded thread name
         with 'M' metadata naming the track.  Span/trace ids ride in
-        args so Perfetto's query surface can join parent/child."""
-        spans = self.snapshot()
+        args so Perfetto's query surface can join parent/child.
+        ``clear=True`` drains the ring atomically with the read (the
+        /debug/trace?clear=1 contract — no span dropped or duplicated
+        against a concurrent scrape)."""
+        spans = self.snapshot(clear=clear)
         tids: Dict[str, int] = {}
         events = []
         pid = os.getpid()
@@ -366,6 +387,10 @@ def span(name: str, trace_id: Optional[int] = None,
 
 def instant(name: str, args: Optional[dict] = None, trace_id: int = 0) -> None:
     _tracer.instant(name, args, trace_id)
+
+
+def current_trace_id() -> int:
+    return _tracer.current_trace_id()
 
 
 def step_annotation(trace_id: int):

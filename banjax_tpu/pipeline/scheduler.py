@@ -81,7 +81,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence
 
-from banjax_tpu.obs import trace
+from banjax_tpu.obs import flightrec, trace
 from banjax_tpu.obs.stats import PipelineStats
 from banjax_tpu.pipeline.sizer import AdaptiveBatchSizer
 from banjax_tpu.resilience import failpoints
@@ -284,6 +284,7 @@ class PipelineScheduler:
             return
         self.stats.note_admitted(len(lines))
         deadline: Optional[float] = None
+        shed_burst = 0
         with self._cond:
             self._last_activity = time.monotonic()
             while (
@@ -314,6 +315,7 @@ class PipelineScheduler:
                                        "buffered": len(self._buf)})
                 if self._health is not None:
                     self._health.degraded(f"overload: shed {dropped} lines")
+                shed_burst = dropped
             was_empty = not self._buf
             self._buf.extend(lines)
             if was_empty:
@@ -322,6 +324,10 @@ class PipelineScheduler:
                 # at high submit rates (flush/backpressure waiters are woken
                 # by the encode/drain stages, not here)
                 self._cond.notify_all()
+        if shed_burst:
+            # incident capture OUTSIDE the condition lock: the recorder
+            # writes files, and the stage threads must not wait on disk
+            flightrec.notify("shed-burst", f"shed {shed_burst} lines")
 
     # ---- encode stage ----
 

@@ -63,11 +63,24 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probe_in_flight = False
         self.trip_count = 0
+        # cumulative seconds spent OPEN (closed intervals only; the
+        # current open stretch is added at read time) — the SLO engine's
+        # breaker-open burn-rate source (obs/slo.py)
+        self._open_seconds_accum = 0.0
 
     @property
     def state(self) -> str:
         with self._lock:
             return self._state
+
+    def open_seconds_total(self) -> float:
+        """Cumulative wall seconds the breaker has been OPEN, including
+        the in-progress stretch — monotone, safe for windowed deltas."""
+        with self._lock:
+            total = self._open_seconds_accum
+            if self._state == OPEN:
+                total += max(0.0, self._clock() - self._opened_at)
+            return total
 
     def allow(self) -> bool:
         """True: caller may take the protected (device) path. False:
@@ -77,6 +90,9 @@ class CircuitBreaker:
                 return True
             if self._state == OPEN:
                 if self._clock() - self._opened_at >= self.recovery_seconds:
+                    self._open_seconds_accum += max(
+                        0.0, self._clock() - self._opened_at
+                    )
                     self._state = HALF_OPEN
                     self._probe_in_flight = True
                     return True
@@ -89,6 +105,12 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            if self._state == OPEN:
+                # a straggler success landing while OPEN closes the
+                # breaker; bank the open stretch before leaving the state
+                self._open_seconds_accum += max(
+                    0.0, self._clock() - self._opened_at
+                )
             self._failures = 0
             self._probe_in_flight = False
             self._state = CLOSED

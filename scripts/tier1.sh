@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Tier-1 verify: the gate every PR must keep green (ROADMAP.md).
+#
+# Two stages, fail-fast:
+#   1. exposition-schema / docs sync — scripts/check_metrics_docs.py in
+#      CHECK mode: a renamed Prometheus family or an undocumented
+#      registry entry fails HERE, not on a dashboard.  (The pytest
+#      schema-stability suite, tests/unit/test_exposition.py, re-asserts
+#      the same registry against real snapshots in stage 2.)
+#   2. the full tier-1 pytest run (slow-marked tests excluded).
+#
+# Usage: scripts/tier1.sh [extra pytest args]
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tier1: metrics docs / registry sync =="
+python scripts/check_metrics_docs.py || {
+    echo "tier1: metrics docs out of sync (run scripts/check_metrics_docs.py --write)" >&2
+    exit 1
+}
+
+echo "== tier1: pytest (not slow) =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider "$@"

@@ -1,0 +1,112 @@
+"""Differential proof of zero behavior change from the attribution
+layer: a pipelined run with the provenance ledger, the SLO engine, and
+the flight recorder all enabled produces byte-identical ban-log /
+result-stream / window-state output to a run with all three disabled —
+the ledger is passive by construction (ISSUE 6 acceptance)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from banjax_tpu.matcher.runner import TpuMatcher
+from banjax_tpu.obs import flightrec, provenance, trace
+from banjax_tpu.obs.flightrec import FlightRecorder
+from banjax_tpu.obs.slo import SloEngine
+from banjax_tpu.pipeline import PipelineScheduler
+from tests.differential.test_pipeline_differential import (
+    ChurnSizer,
+    _build,
+    _gen_lines,
+)
+from tests.differential.test_tpu_matcher import result_key
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset_after():
+    yield
+    provenance.configure(enabled=True)
+    flightrec.install(None)
+    trace.configure(enabled=False)
+
+
+def _run_pipelined(lines, now, device_windows, seed, obs_on, tmp_path):
+    matcher, states, dyn, ban_log = _build(TpuMatcher, device_windows)
+    engine = None
+    if obs_on:
+        provenance.configure(enabled=True, ring_size=8192)
+        engine = SloEngine(
+            matcher_getter=lambda: matcher,
+            pipeline_getter=lambda: sched,  # noqa: F821 — bound below
+            batch_budget_s_fn=lambda: 0.25,
+        )
+        flightrec.install(FlightRecorder(
+            str(tmp_path / f"inc-{seed}"), min_interval_s=0.0,
+            slo_getter=lambda: engine,
+        ))
+    else:
+        provenance.configure(enabled=False)
+        flightrec.install(None)
+
+    collected = []
+    lock = threading.Lock()
+
+    def sink(batch_lines, results):
+        with lock:
+            collected.append((batch_lines, results))
+
+    sched = PipelineScheduler(
+        lambda: matcher, on_results=sink, now_fn=lambda: now
+    )
+    sched._sizer = ChurnSizer(seed=seed)
+    sched.start()
+    rng = random.Random(31)
+    i = 0
+    n_sampled = 0
+    while i < len(lines):
+        step = rng.randrange(1, 90)
+        sched.submit(lines[i : i + step])
+        i += step
+        if engine is not None and i // 400 > n_sampled:
+            n_sampled += 1
+            engine.sample()  # live sampling mid-stream, like production
+    assert sched.flush(120)
+    if engine is not None:
+        engine.sample()
+    sched.stop()
+    matcher.close()
+    results = {}
+    for batch_lines, batch_results in collected:
+        if batch_results is None:
+            continue
+        for line, res in zip(batch_lines, batch_results):
+            results.setdefault(line, []).append(result_key(res))
+    return results, ban_log.getvalue(), states.format_states()
+
+
+@pytest.mark.parametrize("device_windows", [False, True])
+def test_provenance_slo_flightrec_on_off_byte_identical(
+    device_windows, tmp_path
+):
+    now = time.time()
+    lines = _gen_lines(1200, now)
+
+    off_results, off_log, off_states = _run_pipelined(
+        lines, now, device_windows, seed=7, obs_on=False, tmp_path=tmp_path
+    )
+    on_results, on_log, on_states = _run_pipelined(
+        lines, now, device_windows, seed=7, obs_on=True, tmp_path=tmp_path
+    )
+    assert on_log == off_log          # ban-log bytes identical
+    assert on_results == off_results  # per-line result stream identical
+    assert on_states == off_states    # rate-limit window state identical
+    # ... and the enabled run actually ledgered the bans it fired
+    assert provenance.get_ledger().total_records() > 0
+    banned_ips = {
+        (rec["ip"], rec["rule"])
+        for src in ("rate_limit",)
+        for rec in provenance.get_ledger().tail(10_000)
+        if rec["source"] == src
+    }
+    assert banned_ips, "no rate-limit provenance recorded on the on-run"

@@ -145,3 +145,32 @@ def test_window_size_validation():
         "breaker_failure_threshold: 3\nbreaker_window_size: 8\n"
     )
     assert cfg.breaker_window_size == 8
+
+
+def test_open_seconds_total_accumulates_across_cycles():
+    """obs/slo.py's breaker-open burn source: OPEN time accumulates
+    monotonically across trip→recover cycles, including the in-progress
+    stretch, and HALF_OPEN/CLOSED time never counts."""
+    from banjax_tpu.resilience.breaker import CircuitBreaker
+
+    t = {"now": 0.0}
+    br = CircuitBreaker(failure_threshold=1, recovery_seconds=10.0,
+                        clock=lambda: t["now"])
+    assert br.open_seconds_total() == 0.0
+    br.record_failure()  # trips OPEN at t=0
+    t["now"] = 4.0
+    assert br.open_seconds_total() == 4.0  # in-progress stretch counts
+    t["now"] = 10.0
+    assert br.allow()  # OPEN → HALF_OPEN probe; 10 s banked
+    assert br.open_seconds_total() == 10.0
+    t["now"] = 12.0
+    assert br.open_seconds_total() == 10.0  # HALF_OPEN time is not open
+    br.record_failure()  # probe fails: re-OPEN at t=12
+    t["now"] = 15.0
+    assert br.open_seconds_total() == 13.0  # 10 banked + 3 in progress
+    t["now"] = 22.0
+    assert br.allow()
+    br.record_success()  # probe succeeds → CLOSED; 10+10 banked
+    assert br.open_seconds_total() == 20.0
+    t["now"] = 100.0
+    assert br.open_seconds_total() == 20.0  # closed time never counts

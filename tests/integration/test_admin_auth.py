@@ -33,7 +33,9 @@ regexes_with_rates:
 """
 
 TOKEN = "sekrit-scraper-token"
-ADMIN_ROUTES = ("/healthz", "/metrics", "/debug/trace")
+ADMIN_ROUTES = ("/healthz", "/metrics", "/debug/trace",
+                "/decisions/explain?ip=9.9.9.9", "/debug/incidents")
+N_ADMIN = len(ADMIN_ROUTES)
 
 
 def _deps(cfg):
@@ -106,7 +108,7 @@ def test_aiohttp_admin_routes_open_on_loopback():
     statuses = _drive_app(
         cfg, "127.0.0.1", [(p, {}) for p in ADMIN_ROUTES]
     )
-    assert statuses == [200, 200, 200]
+    assert statuses == [200] * N_ADMIN
 
 
 def test_aiohttp_admin_routes_gated_non_loopback():
@@ -116,9 +118,9 @@ def test_aiohttp_admin_routes_gated_non_loopback():
     wrong = [(p, {"Authorization": "Bearer nope"}) for p in ADMIN_ROUTES]
     good = [(p, {"Authorization": f"Bearer {TOKEN}"}) for p in ADMIN_ROUTES]
     statuses = _drive_app(cfg, "0.0.0.0", bare + wrong + good)
-    assert statuses[:3] == [401, 401, 401]
-    assert statuses[3:6] == [401, 401, 401]
-    assert statuses[6:] == [200, 200, 200]
+    assert statuses[:N_ADMIN] == [401] * N_ADMIN
+    assert statuses[N_ADMIN:2 * N_ADMIN] == [401] * N_ADMIN
+    assert statuses[2 * N_ADMIN:] == [200] * N_ADMIN
 
 
 def test_aiohttp_non_admin_routes_stay_open_non_loopback():
@@ -227,3 +229,113 @@ def test_fastserve_native_healthz_auth(listen_host, auth, expect):
     assert proto.sent.startswith(expect), proto.sent[:80]
     if expect.endswith(b"401"):
         assert b"WWW-Authenticate: Bearer" in proto.sent
+
+
+def test_new_admin_routes_are_worker_proxied():
+    """Workers own no ledger/recorder: the new observability routes must
+    be in COLD_ROUTES (reverse-proxied to the primary) and registered by
+    install_proxy_routes on a worker app — same path as /metrics."""
+    from aiohttp import web
+
+    from banjax_tpu.httpapi.workers import COLD_ROUTES, install_proxy_routes
+
+    for route in ("/decisions/explain", "/debug/incidents",
+                  "/metrics", "/debug/trace", "/healthz"):
+        assert route in COLD_ROUTES, route
+
+    app = web.Application()
+    install_proxy_routes(app, "/nonexistent-primary.sock")
+    registered = {r.resource.canonical for r in app.router.routes()
+                  if r.resource is not None}
+    assert "/decisions/explain" in registered
+    assert "/debug/incidents" in registered
+
+
+def test_worker_layout_proxies_new_routes_behind_auth():
+    """The full worker layout end-to-end: a build_app(worker_proxy_sock=…)
+    application proxies /decisions/explain and /debug/incidents to the
+    primary's aiohttp app over a unix socket, and the primary's admin
+    gate (non-loopback + token) answers through the proxy."""
+    import tempfile
+
+    from aiohttp import web
+
+    cfg = config_from_yaml_text(RULES_YAML)
+    cfg.admin_token = TOKEN
+    deps = _deps(cfg)
+
+    async def go():
+        with tempfile.TemporaryDirectory() as td:
+            sock = f"{td}/primary.sock"
+            # primary: the real app, gated as a non-loopback listener
+            primary = server_mod.build_app(deps, listen_host="0.0.0.0")
+            prunner = web.AppRunner(primary)
+            await prunner.setup()
+            await web.UnixSite(prunner, sock).start()
+            # worker: proxy-only app
+            worker = server_mod.build_app(deps, worker_proxy_sock=sock,
+                                          listen_host="0.0.0.0")
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(worker))
+            await client.start_server()
+            try:
+                out = []
+                for path in ("/decisions/explain?ip=9.9.9.9",
+                             "/debug/incidents"):
+                    r = await client.get(path)
+                    out.append(r.status)
+                    r = await client.get(
+                        path, headers={"Authorization": f"Bearer {TOKEN}"}
+                    )
+                    out.append((r.status, await r.json()))
+                return out
+            finally:
+                await client.close()
+                await prunner.cleanup()
+
+    out = asyncio.run(go())
+    assert out[0] == 401                       # explain: gated via proxy
+    assert out[1][0] == 200
+    assert out[1][1]["ip"] == "9.9.9.9"
+    assert out[2] == 401                       # incidents: gated via proxy
+    assert out[3][0] == 200
+    assert out[3][1]["incidents"] == []
+
+
+def test_decisions_explain_route_payload():
+    from banjax_tpu.decisions.model import Decision
+    from banjax_tpu.obs import provenance
+
+    provenance.configure(enabled=True, ring_size=64)
+    try:
+        cfg = config_from_yaml_text(RULES_YAML)
+        deps = _deps(cfg)
+        provenance.record(provenance.SOURCE_KAFKA, "6.6.6.6",
+                          Decision.NGINX_BLOCK, rule="block_ip")
+        deps.dynamic_lists.update("6.6.6.6", 9999999999.0,
+                                  Decision.NGINX_BLOCK, True, "h.com")
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def go():
+            app = server_mod.build_app(deps)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get("/decisions/explain",
+                                     params={"ip": "6.6.6.6"})
+                missing = await client.get("/decisions/explain")
+                return r.status, await r.json(), missing.status
+            finally:
+                await client.close()
+
+        status, payload, missing_status = asyncio.run(go())
+        assert status == 200
+        assert missing_status == 400  # ip param required
+        assert payload["ledger_enabled"] is True
+        assert payload["records"][0]["source"] == "kafka"
+        assert payload["records"][0]["rule"] == "block_ip"
+        assert payload["active_decision"]["decision"] == "NginxBlock"
+        assert payload["active_decision"]["from_baskerville"] is True
+    finally:
+        provenance.configure(enabled=True)

@@ -1,0 +1,320 @@
+"""ISSUE 6 acceptance: GET /decisions/explain returns source/rule/
+window-count/trace-id provenance for bans produced by all four decision
+sources (static+UA lists, regex rate limiter, Kafka commands, challenge
+failures — PAPER.md §0), and a forced SLO breach under failpoints
+produces a loadable incident bundle (valid Perfetto JSON + parseable
+metrics snapshot) listed by /debug/incidents."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+from banjax_tpu.decisions.rate_limit import (
+    FailedChallengeRateLimitStates,
+    RegexRateLimitStates,
+)
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.httpapi import server as server_mod
+from banjax_tpu.httpapi.decision_chain import (
+    ChainState,
+    RequestInfo,
+    decision_for_nginx,
+    too_many_failed_challenges,
+)
+from banjax_tpu.ingest.kafka_io import handle_command
+from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from banjax_tpu.obs import flightrec, provenance, trace
+from banjax_tpu.obs.exposition import parse_text_format, render_prometheus
+from banjax_tpu.obs.flightrec import FlightRecorder
+from banjax_tpu.obs.slo import SloEngine
+from banjax_tpu.pipeline import PipelineScheduler
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.matcher.runner import TpuMatcher
+from tests.mock_banner import MockBanner
+
+CONFIG_YAML = r"""
+config_version: provenance-test
+regexes_with_rates:
+  - decision: nginx_block
+    rule: "rate_limit_rule"
+    regex: 'GET .*'
+    interval: 60
+    hits_per_interval: 3
+global_decision_lists:
+  nginx_block:
+    - 70.80.90.100
+global_user_agent_decision_lists:
+  nginx_block:
+    - "BadBot"
+iptables_ban_seconds: 10
+kafka_brokers: [localhost:9092]
+server_log_file: /tmp/banjax-prov-test.log
+expiring_decision_ttl_seconds: 300
+too_many_failed_challenges_interval_seconds: 60
+too_many_failed_challenges_threshold: 2
+hmac_secret: secret
+session_cookie_hmac_secret: session_secret
+disable_kafka: true
+"""
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    provenance.configure(enabled=True, ring_size=512)
+    yield
+    provenance.configure(enabled=True)
+    flightrec.install(None)
+    trace.configure(enabled=False)
+    failpoints.disarm()
+
+
+def _chain_state(config, dynamic):
+    return ChainState(
+        config=config,
+        static_lists=StaticDecisionLists(config),
+        dynamic_lists=dynamic,
+        protected_paths=PasswordProtectedPaths(config),
+        failed_challenge_states=FailedChallengeRateLimitStates(),
+        banner=MockBanner(dynamic),
+    )
+
+
+def _req(ip, ua="mozilla", host="example.com"):
+    return RequestInfo(client_ip=ip, requested_host=host,
+                       requested_path="/", client_user_agent=ua,
+                       method="GET", cookies={})
+
+
+def _explain(deps, ip):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        app = server_mod.build_app(deps)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/decisions/explain", params={"ip": ip})
+            assert r.status == 200
+            return await r.json()
+        finally:
+            await client.close()
+
+    return asyncio.run(go())
+
+
+def test_explain_covers_all_four_decision_sources():
+    config = config_from_yaml_text(CONFIG_YAML)
+    dynamic = DynamicDecisionLists(start_sweeper=False)
+    state = _chain_state(config, dynamic)
+    now = time.time()
+
+    # source 1: static IP list hit (global nginx_block)
+    resp, _ = decision_for_nginx(state, _req("70.80.90.100"))
+    assert resp.status == 403
+    # source 1b: UA list hit
+    resp, _ = decision_for_nginx(state, _req("71.71.71.71", ua="BadBot"))
+    assert resp.status == 403
+
+    # source 2: regex rate limiter firing a ban (4th hit > 3/interval)
+    matcher = CpuMatcher(config, MockBanner(dynamic), state.static_lists,
+                         RegexRateLimitStates())
+    for _ in range(4):
+        matcher.consume_line(
+            f"{now:f} 9.9.9.9 GET example.com GET /x HTTP/1.1 ua", now
+        )
+
+    # source 3: a Kafka block_ip command
+    handle_command(config, {"Name": "block_ip", "Value": "5.6.7.8",
+                            "host": "example.com"}, dynamic)
+
+    # source 4: too many failed challenges (threshold 2 → 3rd exceeds)
+    for _ in range(3):
+        too_many_failed_challenges(state, _req("3.3.3.3"), "password")
+
+    deps = server_mod.ServerDeps(
+        config_holder=type("H", (), {"get": lambda self: config})(),
+        static_lists=state.static_lists,
+        dynamic_lists=dynamic,
+        protected_paths=state.protected_paths,
+        regex_states=RegexRateLimitStates(),
+        failed_challenge_states=state.failed_challenge_states,
+        banner=state.banner,
+    )
+
+    static_recs = _explain(deps, "70.80.90.100")["records"]
+    assert any(r["source"] == "static_list" and r["rule"] == "GlobalBlock"
+               and r["decision"] == "NginxBlock" for r in static_recs)
+
+    ua_recs = _explain(deps, "71.71.71.71")["records"]
+    assert any(r["source"] == "ua_list" and r["rule"] == "GlobalUABlock"
+               for r in ua_recs)
+
+    rate_payload = _explain(deps, "9.9.9.9")
+    rate = [r for r in rate_payload["records"]
+            if r["source"] == "rate_limit"]
+    assert rate and rate[0]["rule"] == "rate_limit_rule"
+    assert rate[0]["hits"] == 4  # window count at fire time (3 + 1)
+    assert rate_payload["active_decision"]["decision"] == "NginxBlock"
+
+    kafka_recs = _explain(deps, "5.6.7.8")["records"]
+    assert any(r["source"] == "kafka" and r["rule"] == "block_ip"
+               and r["decision"] == "NginxBlock" for r in kafka_recs)
+
+    fc_recs = _explain(deps, "3.3.3.3")["records"]
+    assert any(r["source"] == "challenge_failure"
+               and r["rule"] == "failed challenge password"
+               and r["hits"] == 2
+               and r["decision"] == "IptablesBlock" for r in fc_recs)
+
+
+def test_rate_limit_provenance_carries_admitting_batch_trace_id():
+    """A ban fired on a traced pipeline drain thread is attributed to the
+    admitting batch's trace id — the explain record joins straight into
+    the /debug/trace Perfetto dump."""
+    trace.configure(enabled=True, ring_size=8192)
+    config = config_from_yaml_text(CONFIG_YAML)
+    dynamic = DynamicDecisionLists(start_sweeper=False)
+    matcher = TpuMatcher(config, MockBanner(dynamic),
+                         StaticDecisionLists(config),
+                         RegexRateLimitStates())
+    now = time.time()
+    sched = PipelineScheduler(lambda: matcher, now_fn=lambda: now)
+    sched.start()
+    sched.submit([
+        f"{now:f} 6.6.6.6 GET example.com GET /x HTTP/1.1 ua"
+        for _ in range(8)
+    ])
+    assert sched.flush(120)
+    sched.stop()
+    matcher.close()
+
+    recs = [r for r in provenance.get_ledger().explain("6.6.6.6")
+            if r["source"] == "rate_limit"]
+    assert recs, "rate-limit ban did not land in the ledger"
+    tids = {r["trace_id"] for r in recs}
+    assert tids and 0 not in tids, "ban not attributed to a traced batch"
+    span_tids = {s["trace_id"] for s in trace.get_tracer().snapshot()}
+    assert tids <= span_tids, "ledger trace ids missing from the span ring"
+
+
+def test_forced_slo_breach_produces_loadable_incident_bundle(tmp_path):
+    """Failpoint pipeline.drain=error forces drain losses → the shed SLO
+    breaches → the flight recorder captures a bundle that is listed by
+    /debug/incidents and loads: trace.json is valid Perfetto JSON,
+    metrics.prom parses under the strict exposition parser."""
+    trace.configure(enabled=True, ring_size=4096)
+    config = config_from_yaml_text(CONFIG_YAML)
+    dynamic = DynamicDecisionLists(start_sweeper=False)
+    states = RegexRateLimitStates()
+    fc_states = FailedChallengeRateLimitStates()
+    matcher = TpuMatcher(config, MockBanner(dynamic),
+                         StaticDecisionLists(config), states)
+    now = time.time()
+    sched = PipelineScheduler(lambda: matcher, now_fn=lambda: now)
+
+    engine = SloEngine(
+        matcher_getter=lambda: matcher,
+        pipeline_getter=lambda: sched,
+        batch_budget_s_fn=lambda: 0.25,
+        shed_ratio_max=0.001,
+    )
+    recorder = FlightRecorder(
+        str(tmp_path / "incidents"), min_interval_s=0.0,
+        metrics_text_fn=lambda: render_prometheus(
+            dynamic, states, fc_states, matcher=matcher, pipeline=sched,
+            slo=engine, flightrec=flightrec.installed(),
+        ),
+        config_hash_fn=lambda: "testhash",
+        slo_getter=lambda: engine,
+    )
+    flightrec.install(recorder)
+    breaches = []
+
+    def on_breach(name, burn):
+        breaches.append(name)
+        flightrec.notify(f"slo-{name}", f"burn {burn}")
+
+    engine._on_breach = on_breach
+
+    engine.sample()
+    sched.start()
+    failpoints.arm_from_spec("pipeline.drain=error:999")
+    try:
+        sched.submit([
+            f"{now:f} 10.0.0.{i % 256} GET example.com GET /x HTTP/1.1 ua"
+            for i in range(512)
+        ])
+        assert sched.flush(120)
+    finally:
+        failpoints.disarm()
+    newly = engine.sample()
+    sched.stop()
+    matcher.close()
+
+    assert "shed_ratio" in newly and breaches == ["shed_ratio"]
+    assert recorder.incident_count == 1
+
+    # the bundle loads: Perfetto JSON + strictly-parseable metrics
+    incidents = recorder.list_incidents()
+    assert len(incidents) == 1
+    name = incidents[0]["name"]
+    assert incidents[0]["reason"] == "slo-shed_ratio"
+    trace_doc = json.loads(recorder.read_file(name, "trace.json"))
+    assert {e["ph"] for e in trace_doc["traceEvents"]} >= {"X", "M"}
+    fams = parse_text_format(
+        recorder.read_file(name, "metrics.prom").decode()
+    )
+    assert "banjax_slo_burn_rate" in fams
+    assert "banjax_slo_breached" in fams
+    assert "banjax_pipeline_drain_error_lines_total" in fams
+    meta = json.loads(recorder.read_file(name, "meta.json"))
+    assert meta["config_hash"] == "testhash"
+    assert meta["slo"]["breached"]["shed_ratio"] is True
+
+    # ... and /debug/incidents serves it
+    deps = server_mod.ServerDeps(
+        config_holder=type("H", (), {"get": lambda self: config})(),
+        static_lists=StaticDecisionLists(config),
+        dynamic_lists=dynamic,
+        protected_paths=PasswordProtectedPaths(config),
+        regex_states=states,
+        failed_challenge_states=fc_states,
+        banner=MockBanner(dynamic),
+        flightrec_getter=lambda: recorder,
+    )
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        app = server_mod.build_app(deps)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            listing = await (await client.get("/debug/incidents")).json()
+            manifest = await (await client.get(
+                "/debug/incidents", params={"name": name}
+            )).json()
+            raw = await client.get(
+                "/debug/incidents", params={"name": name,
+                                            "file": "trace.json"}
+            )
+            missing = await client.get(
+                "/debug/incidents", params={"name": name,
+                                            "file": "../secret"}
+            )
+            return listing, manifest, raw.status, await raw.json(), \
+                missing.status
+        finally:
+            await client.close()
+
+    listing, manifest, raw_status, raw_doc, missing_status = asyncio.run(go())
+    assert listing["enabled"] is True
+    assert listing["incidents"][0]["name"] == name
+    assert manifest["reason"] == "slo-shed_ratio"
+    assert raw_status == 200 and "traceEvents" in raw_doc
+    assert missing_status == 404

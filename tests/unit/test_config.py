@@ -180,3 +180,44 @@ regexes_with_rates:
 """
     )
     assert cfg.regexes_with_rates[0].regex.search("a}}}")
+
+
+def test_provenance_slo_flightrec_keys_defaults_and_validation():
+    cfg = config_from_yaml_text("")
+    assert cfg.provenance_enabled is True
+    assert cfg.provenance_ring_size == 2048
+    assert cfg.slo_enabled is True
+    assert cfg.slo_sample_seconds == 15.0
+    assert cfg.slo_batch_latency_target == 0.99
+    assert cfg.slo_shed_ratio_max == 0.001
+    assert cfg.flightrec_dir == ""
+    assert cfg.flightrec_min_interval_s == 60.0
+    assert cfg.flightrec_keep == 16
+
+    cfg = config_from_yaml_text(
+        "provenance_ring_size: 128\n"
+        "slo_batch_latency_target: 0.999\n"
+        "flightrec_dir: /tmp/incidents\n"
+        "flightrec_keep: 4\n"
+    )
+    assert cfg.provenance_ring_size == 128
+    assert cfg.slo_batch_latency_target == 0.999
+    assert cfg.flightrec_dir == "/tmp/incidents"
+    assert cfg.flightrec_keep == 4
+
+    for bad in (
+        "provenance_ring_size: 0",
+        "slo_batch_latency_target: 1.0",
+        "slo_batch_latency_target: 0",
+        "slo_shed_ratio_max: 0",
+        "slo_stale_ratio_max: -1",
+        "slo_breaker_open_ratio_max: 0",
+        "slo_budget_trip_ratio_max: 0",
+        "slo_sample_seconds: -1",
+        "flightrec_min_interval_s: -1",
+        "flightrec_keep: 0",
+        "flightrec_provenance_records: 0",
+        'provenance_enabled: "yes"',
+    ):
+        with pytest.raises(ValueError):
+            config_from_yaml_text(bad)

@@ -257,3 +257,78 @@ def test_check_metrics_docs_passes_and_catches_drift(tmp_path):
     )
     assert r.returncode == 1
     assert "banjax_matcher_lines_total" in r.stderr
+
+
+def test_provenance_slo_flightrec_families_render_and_declare(
+    loaded_system, tmp_path
+):
+    """The ISSUE 6 families: banjax_decision_inserts_total{source,
+    decision}, banjax_slo_burn_rate{slo,window}, the one-hot
+    banjax_slo_breached, banjax_matcher_budget_trips_total and
+    banjax_flightrec_incidents_total all render from real objects,
+    parse strictly, and are registry-declared."""
+    from banjax_tpu.obs import provenance
+    from banjax_tpu.obs.flightrec import FlightRecorder
+    from banjax_tpu.obs.slo import SloEngine
+
+    m, sched, health, sup = loaded_system
+    provenance.configure(enabled=True, ring_size=64)
+    try:
+        provenance.record(provenance.SOURCE_KAFKA, "1.2.3.4", "NginxBlock",
+                          rule="block_ip")
+        provenance.record(provenance.SOURCE_RATE_LIMIT, "1.2.3.4",
+                          "Challenge", rule="r")
+        m.budget_trips += 2
+        engine = SloEngine(
+            matcher_getter=lambda: m, pipeline_getter=lambda: sched,
+            batch_budget_s_fn=lambda: 0.25,
+        )
+        engine.sample()
+        engine.sample()
+        rec = FlightRecorder(str(tmp_path / "inc"), min_interval_s=0.0)
+        rec.notify("test")
+        text = render_prometheus(
+            DynamicDecisionLists(start_sweeper=False),
+            RegexRateLimitStates(), FailedChallengeRateLimitStates(),
+            matcher=m, pipeline=sched, health=health, supervisor=sup,
+            slo=engine, flightrec=rec,
+        )
+        fams = parse_text_format(text)
+        undeclared = [f for f in fams if f not in registry.PROM_FAMILIES]
+        assert not undeclared, undeclared
+
+        inserts = {
+            (s[1]["source"], s[1]["decision"]): s[2]
+            for s in fams["banjax_decision_inserts_total"]["samples"]
+        }
+        assert inserts[("kafka", "NginxBlock")] == 1
+        assert inserts[("rate_limit", "Challenge")] == 1
+
+        burn = {
+            (s[1]["slo"], s[1]["window"])
+            for s in fams["banjax_slo_burn_rate"]["samples"]
+        }
+        assert ("batch_latency", "5m") in burn
+        assert ("shed_ratio", "5m") in burn
+        breached = {
+            s[1]["slo"]: s[2]
+            for s in fams["banjax_slo_breached"]["samples"]
+        }
+        assert set(breached) == {
+            "batch_latency", "shed_ratio", "stale_ratio", "breaker_open",
+            "budget_trips",
+        }
+        scalars = {
+            s[0]: s[2] for ent in fams.values() for s in ent["samples"]
+            if not s[1]
+        }
+        assert scalars["banjax_matcher_budget_trips_total"] == 2
+        assert scalars["banjax_flightrec_incidents_total"] == 1
+    finally:
+        provenance.configure(enabled=True)
+
+
+def test_budget_trips_on_the_29s_line(loaded_system):
+    line = _full_line(*loaded_system)
+    assert "MatcherBudgetTrips" in line
+    assert registry.is_declared_line_key("MatcherBudgetTrips")

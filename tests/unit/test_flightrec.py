@@ -1,0 +1,128 @@
+"""Incident flight recorder (obs/flightrec.py): bundle layout and
+atomicity, capture debounce, retention pruning, path-traversal guards
+on the read surface, and the module-level trigger hook."""
+
+import json
+import os
+
+import pytest
+
+from banjax_tpu.obs import flightrec, provenance, trace
+from banjax_tpu.obs.flightrec import FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_modules():
+    yield
+    flightrec.install(None)
+    provenance.configure(enabled=True)
+    trace.configure(enabled=False)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _recorder(tmp_path, **kw):
+    kw.setdefault("min_interval_s", 0.0)
+    return FlightRecorder(str(tmp_path / "incidents"), **kw)
+
+
+def test_bundle_layout_and_contents(tmp_path):
+    provenance.configure(enabled=True, ring_size=64)
+    provenance.record(provenance.SOURCE_KAFKA, "4.4.4.4", "NginxBlock",
+                      rule="block_ip")
+    tracer = trace.configure(enabled=True, ring_size=64)
+    tid = tracer.new_trace()
+    with tracer.span("drain", tid, parent=0):
+        pass
+    rec = _recorder(
+        tmp_path,
+        metrics_text_fn=lambda: "# HELP x y\n# TYPE x counter\nx 1\n",
+        config_hash_fn=lambda: "abc123",
+    )
+    name = rec.notify("breaker-trip", "matcher-device")
+    assert name is not None and name.startswith("incident-")
+    bundle = tmp_path / "incidents" / name
+    assert sorted(os.listdir(bundle)) == [
+        "meta.json", "metrics.prom", "provenance.json", "trace.json"
+    ]
+    trace_doc = json.loads((bundle / "trace.json").read_text())
+    assert any(e.get("ph") == "X" for e in trace_doc["traceEvents"])
+    prov_doc = json.loads((bundle / "provenance.json").read_text())
+    assert prov_doc["records"][-1]["ip"] == "4.4.4.4"
+    assert prov_doc["counters"]["kafka/NginxBlock"] == 1
+    meta = json.loads((bundle / "meta.json").read_text())
+    assert meta["reason"] == "breaker-trip"
+    assert meta["detail"] == "matcher-device"
+    assert meta["config_hash"] == "abc123"
+    assert (bundle / "metrics.prom").read_text().endswith("x 1\n")
+    # no stranded tmp dirs: publish is rename-atomic
+    assert not [e for e in os.listdir(tmp_path / "incidents")
+                if e.endswith(".tmp")]
+    assert rec.incident_count == 1
+
+
+def test_debounce_bounds_capture_rate(tmp_path):
+    clock = Clock()
+    rec = _recorder(tmp_path, min_interval_s=60.0, clock=clock)
+    assert rec.notify("shed-burst") is not None
+    clock.t += 30.0
+    assert rec.notify("shed-burst") is None       # inside the interval
+    clock.t += 31.0
+    assert rec.notify("breaker-trip") is not None  # past it
+    assert rec.incident_count == 2
+
+
+def test_prune_keeps_newest(tmp_path):
+    clock = Clock()
+    rec = _recorder(tmp_path, keep=3, clock=clock)
+    names = []
+    for i in range(6):
+        clock.t += 1
+        names.append(rec.notify(f"r{i}"))
+    listed = [e["name"] for e in rec.list_incidents()]
+    assert len(listed) == 3
+    assert set(listed) <= set(names[-3:]) | set(names)  # newest retained
+    for stale in names[:3]:
+        assert stale not in listed
+
+
+def test_list_and_read_surface(tmp_path):
+    rec = _recorder(tmp_path, metrics_text_fn=lambda: "m 1\n")
+    name = rec.notify("slo-shed_ratio", "burn 50")
+    entries = rec.list_incidents()
+    assert entries[0]["name"] == name
+    assert entries[0]["reason"] == "slo-shed_ratio"
+    assert "meta.json" in entries[0]["files"]
+    assert rec.read_file(name, "metrics.prom") == b"m 1\n"
+    assert rec.read_file(name, "nope.json") is None
+    # traversal attempts are refused, not resolved
+    assert rec.read_file("../" + name, "meta.json") is None
+    assert rec.read_file(name, "../../etc/passwd") is None
+    assert rec.read_file("incident-evil/..", "meta.json") is None
+
+
+def test_capture_failure_never_propagates(tmp_path):
+    def boom():
+        raise RuntimeError("render failed")
+
+    rec = _recorder(tmp_path, metrics_text_fn=boom)
+    name = rec.notify("breaker-trip")
+    # the bundle still lands, with the failure noted in metrics.prom
+    assert name is not None
+    data = rec.read_file(name, "metrics.prom")
+    assert b"capture failed" in data
+
+
+def test_module_hook_noop_without_recorder(tmp_path):
+    flightrec.install(None)
+    assert flightrec.notify("breaker-trip") is None
+    rec = _recorder(tmp_path)
+    flightrec.install(rec)
+    assert flightrec.notify("breaker-trip") is not None
+    assert flightrec.installed() is rec

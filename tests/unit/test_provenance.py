@@ -1,0 +1,106 @@
+"""Decision provenance ledger (obs/provenance.py): per-source rings,
+counters, explain/tail queries, the disabled fast path, and thread
+safety under concurrent recording."""
+
+import threading
+
+import pytest
+
+from banjax_tpu.obs import provenance, trace
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    ledger = provenance.configure(enabled=True, ring_size=64)
+    yield ledger
+    provenance.configure(enabled=True)
+    trace.configure(enabled=False)
+
+
+def test_record_and_explain_roundtrip(_fresh_ledger):
+    provenance.record(provenance.SOURCE_RATE_LIMIT, "9.9.9.9",
+                      "NginxBlock", rule="crawler", rule_index=3, hits=51)
+    provenance.record(provenance.SOURCE_KAFKA, "9.9.9.9", "Challenge",
+                      rule="challenge_ip")
+    provenance.record(provenance.SOURCE_KAFKA, "8.8.8.8", "NginxBlock",
+                      rule="block_ip")
+
+    recs = provenance.get_ledger().explain("9.9.9.9")
+    assert [r["source"] for r in recs] == ["rate_limit", "kafka"]
+    assert recs[0]["rule"] == "crawler"
+    assert recs[0]["rule_index"] == 3
+    assert recs[0]["hits"] == 51
+    assert recs[0]["decision"] == "NginxBlock"
+    assert recs[0]["time_unix"] > 0 and recs[0]["t_monotonic"] > 0
+    # records come back oldest-first across sources
+    assert recs[0]["t_monotonic"] <= recs[1]["t_monotonic"]
+    assert provenance.get_ledger().explain("1.1.1.1") == []
+
+
+def test_counters_per_source_and_decision(_fresh_ledger):
+    for _ in range(3):
+        provenance.record(provenance.SOURCE_STATIC, "1.2.3.4", "Allow")
+    provenance.record(provenance.SOURCE_STATIC, "1.2.3.4", "NginxBlock")
+    c = provenance.get_ledger().counters()
+    assert c[("static_list", "Allow")] == 3
+    assert c[("static_list", "NginxBlock")] == 1
+
+
+def test_ring_wraps_keeping_newest(_fresh_ledger):
+    ledger = provenance.configure(enabled=True, ring_size=16)
+    for i in range(40):
+        ledger.record(provenance.SOURCE_EXPIRY, f"10.0.0.{i}", "Challenge")
+    recs = ledger.tail(100)
+    assert len(recs) == 16
+    assert recs[-1]["ip"] == "10.0.0.39"
+    assert recs[0]["ip"] == "10.0.0.24"
+    # counters keep the full total even after the ring wrapped
+    assert ledger.counters()[("expiry", "Challenge")] == 40
+
+
+def test_disabled_ledger_records_nothing():
+    ledger = provenance.configure(enabled=False)
+    provenance.record(provenance.SOURCE_KAFKA, "1.2.3.4", "NginxBlock")
+    assert ledger.explain("1.2.3.4") == []
+    assert ledger.counters() == {}
+    assert ledger.total_records() == 0
+
+
+def test_trace_id_defaults_to_ambient_span(_fresh_ledger):
+    tracer = trace.configure(enabled=True, ring_size=64)
+    tid = tracer.new_trace()
+    with tracer.span("drain", tid, parent=0):
+        provenance.record(provenance.SOURCE_RATE_LIMIT, "7.7.7.7",
+                          "NginxBlock", rule="r")
+    provenance.record(provenance.SOURCE_RATE_LIMIT, "7.7.7.7",
+                      "NginxBlock", rule="r")
+    recs = provenance.get_ledger().explain("7.7.7.7")
+    assert recs[0]["trace_id"] == tid   # inside the span: attributed
+    assert recs[1]["trace_id"] == 0     # outside: no ambient trace
+
+
+def test_unknown_source_never_raises(_fresh_ledger):
+    provenance.record("not-a-source", "1.1.1.1", "Allow")
+    assert provenance.get_ledger().explain("1.1.1.1")  # filed, not lost
+
+
+def test_concurrent_recording_is_consistent(_fresh_ledger):
+    ledger = provenance.configure(enabled=True, ring_size=4096)
+    n_threads, per_thread = 4, 250
+
+    def writer(k):
+        for i in range(per_thread):
+            ledger.record(provenance.SOURCE_KAFKA, f"10.{k}.0.{i % 256}",
+                          "NginxBlock", rule=f"t{k}")
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ledger.total_records() == n_threads * per_thread
+    assert ledger.counters()[("kafka", "NginxBlock")] == (
+        n_threads * per_thread
+    )
+    assert len(ledger.tail(10_000)) == n_threads * per_thread

@@ -1,0 +1,206 @@
+"""SLO burn-rate engine (obs/slo.py): windowed burn math from synthetic
+cumulative sources, breach transitions, and the on_breach hook — all
+with an injected clock so windows advance deterministically."""
+
+import pytest
+
+from banjax_tpu.obs.registry import Histogram
+from banjax_tpu.obs.slo import (
+    SLO_BATCH_LATENCY,
+    SLO_BREAKER_OPEN,
+    SLO_BUDGET_TRIPS,
+    SLO_SHED,
+    SLO_STALE,
+    SloEngine,
+)
+from banjax_tpu.obs.stats import PipelineStats
+
+
+class FakeBreaker:
+    def __init__(self):
+        self.open_s = 0.0
+
+    def open_seconds_total(self):
+        return self.open_s
+
+
+class FakeStats:
+    def __init__(self):
+        self.batch_latency_hist = Histogram()
+
+
+class FakeMatcher:
+    def __init__(self):
+        self.stats = FakeStats()
+        self.breaker = FakeBreaker()
+        self.budget_trips = 0
+
+
+class FakePipeline:
+    def __init__(self):
+        self.stats = PipelineStats()
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _engine(matcher, pipeline, clock, **kw):
+    kw.setdefault("batch_latency_target", 0.99)
+    kw.setdefault("shed_ratio_max", 0.01)
+    kw.setdefault("stale_ratio_max", 0.01)
+    kw.setdefault("breaker_open_ratio_max", 0.01)
+    kw.setdefault("budget_trip_ratio_max", 0.01)
+    return SloEngine(
+        matcher_getter=lambda: matcher,
+        pipeline_getter=lambda: pipeline,
+        batch_budget_s_fn=lambda: 0.25,
+        clock=clock,
+        **kw,
+    )
+
+
+def test_healthy_stream_burns_zero():
+    m, p, clock = FakeMatcher(), FakePipeline(), Clock()
+    eng = _engine(m, p, clock)
+    eng.sample()
+    for _ in range(5):
+        clock.t += 60
+        for _ in range(100):
+            m.stats.batch_latency_hist.observe(0.01)  # well within budget
+        p.stats.note_admitted(1000)
+        p.stats.note_processed(1000)
+        assert eng.sample() == []
+    burn = eng.burn_rates()
+    assert burn[SLO_BATCH_LATENCY]["5m"] == 0.0
+    assert burn[SLO_SHED]["5m"] == 0.0
+    assert burn[SLO_STALE]["5m"] == 0.0
+    assert burn[SLO_BREAKER_OPEN]["5m"] == 0.0
+    assert not any(eng.breached().values())
+
+
+def test_shed_burst_breaches_and_fires_once():
+    m, p, clock = FakeMatcher(), FakePipeline(), Clock()
+    breaches = []
+    eng = _engine(m, p, clock,
+                  on_breach=lambda name, burn: breaches.append(name))
+    eng.sample()
+    clock.t += 60
+    p.stats.note_admitted(1000)
+    p.stats.note_shed(500)     # 50% shed vs 1% budget → burn 50
+    p.stats.note_processed(500)
+    newly = eng.sample()
+    assert SLO_SHED in newly
+    assert breaches == [SLO_SHED]
+    assert eng.breached()[SLO_SHED] is True
+    assert eng.burn_rates()[SLO_SHED]["5m"] == pytest.approx(50.0, rel=0.01)
+    # still breached on the next sample, but no re-fire (transition edge)
+    clock.t += 60
+    p.stats.note_admitted(10)
+    p.stats.note_shed(10)
+    assert eng.sample() == []
+    assert breaches == [SLO_SHED]
+
+
+def test_drain_errors_count_into_shed_slo():
+    m, p, clock = FakeMatcher(), FakePipeline(), Clock()
+    eng = _engine(m, p, clock)
+    eng.sample()
+    clock.t += 60
+    p.stats.note_admitted(100)
+    p.stats.note_drain_error(100)
+    eng.sample()
+    assert eng.breached()[SLO_SHED] is True
+
+
+def test_batch_latency_burn_from_histogram_buckets():
+    m, p, clock = FakeMatcher(), FakePipeline(), Clock()
+    eng = _engine(m, p, clock)
+    eng.sample()
+    clock.t += 60
+    for _ in range(90):
+        m.stats.batch_latency_hist.observe(0.01)   # good
+    for _ in range(10):
+        m.stats.batch_latency_hist.observe(2.0)    # blows the 250 ms budget
+    eng.sample()
+    # 10% bad vs a 1% budget → burn 10 on every window
+    assert eng.burn_rates()[SLO_BATCH_LATENCY]["5m"] == pytest.approx(
+        10.0, rel=0.01
+    )
+    assert eng.breached()[SLO_BATCH_LATENCY] is True
+
+
+def test_breaker_open_and_budget_trip_burn():
+    m, p, clock = FakeMatcher(), FakePipeline(), Clock()
+    eng = _engine(m, p, clock)
+    eng.sample()
+    clock.t += 100
+    m.breaker.open_s += 50.0  # open half the span vs 1% budget → burn 50
+    m.budget_trips += 10
+    for _ in range(100):
+        m.stats.batch_latency_hist.observe(0.01)
+    eng.sample()
+    assert eng.burn_rates()[SLO_BREAKER_OPEN]["5m"] == pytest.approx(
+        50.0, rel=0.02
+    )
+    assert eng.burn_rates()[SLO_BUDGET_TRIPS]["5m"] == pytest.approx(
+        10.0, rel=0.02
+    )
+    assert eng.breached()[SLO_BREAKER_OPEN] is True
+    assert eng.breached()[SLO_BUDGET_TRIPS] is True
+
+
+def test_fast_window_recovers_before_slow_window():
+    """A spike ages out of the 5 m window while the 1 h window still
+    remembers it — the multi-window AND keeps recovered systems from
+    staying 'breached' forever, and young spikes from paging twice."""
+    m, p, clock = FakeMatcher(), FakePipeline(), Clock()
+    eng = _engine(m, p, clock)
+    eng.sample()
+    clock.t += 60
+    p.stats.note_admitted(1000)
+    p.stats.note_shed(1000)
+    eng.sample()
+    assert eng.breached()[SLO_SHED] is True
+    # 20 minutes of clean traffic: the 5 m window sees only good deltas
+    for _ in range(20):
+        clock.t += 60
+        p.stats.note_admitted(1000)
+        p.stats.note_processed(1000)
+        eng.sample()
+    burn = eng.burn_rates()
+    assert burn[SLO_SHED]["5m"] == 0.0
+    assert burn[SLO_SHED]["1h"] > 1.0  # the hour still remembers
+    assert eng.breached()[SLO_SHED] is False  # AND over windows
+
+
+def test_snapshot_shape_for_incident_bundles():
+    m, p, clock = FakeMatcher(), FakePipeline(), Clock()
+    eng = _engine(m, p, clock)
+    eng.sample()
+    snap = eng.snapshot()
+    assert set(snap) == {"burn_rates", "breached", "windows", "targets"}
+    assert snap["windows"] == {"5m": 300.0, "1h": 3600.0}
+
+
+def test_rejects_bad_targets():
+    with pytest.raises(ValueError):
+        SloEngine(batch_latency_target=1.0)
+    with pytest.raises(ValueError):
+        SloEngine(shed_ratio_max=0.0)
+
+
+def test_background_sampling_thread_starts_and_stops():
+    m, p = FakeMatcher(), FakePipeline()
+    eng = SloEngine(matcher_getter=lambda: m, pipeline_getter=lambda: p,
+                    batch_budget_s_fn=lambda: 0.25)
+    eng.start(0.05)
+    import time as _time
+
+    _time.sleep(0.2)
+    eng.stop()
+    assert len(eng._samples) >= 2

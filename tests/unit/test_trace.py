@@ -163,3 +163,61 @@ def test_step_annotation_noop_paths(tracer):
         assert t2.step_annotation(0) is trace.NOOP_SPAN
     finally:
         trace.configure(enabled=False)
+
+
+def test_atomic_snapshot_clear_drains_exactly_once():
+    tracer = trace.configure(enabled=True, ring_size=128)
+    try:
+        tid = tracer.new_trace()
+        with tracer.span("drain", tid, parent=0):
+            pass
+        first = tracer.snapshot(clear=True)
+        assert [s["name"] for s in first] == ["drain"]
+        assert tracer.snapshot() == []  # the clear emptied the ring
+    finally:
+        trace.configure(enabled=False)
+
+
+def test_clear_during_concurrent_dump_no_drop_or_dup():
+    """Regression (ISSUE 6 satellite): /debug/trace?clear=1 racing a
+    concurrent scrape must neither drop nor duplicate spans.  Writers
+    record spans with unique ids while two dumper threads hammer the
+    atomic snapshot(clear=True); every span id must surface in exactly
+    one dump."""
+    tracer = trace.configure(enabled=True, ring_size=16384)
+    try:
+        n_writers, per_writer = 2, 1500  # total 3000 << ring: no wrap loss
+        seen = []
+        seen_lock = threading.Lock()
+        stop = threading.Event()
+
+        def writer():
+            tid = tracer.new_trace()
+            for _ in range(per_writer):
+                with tracer.span("drain", tid, parent=0):
+                    pass
+
+        def dumper():
+            while not stop.is_set():
+                spans = tracer.snapshot(clear=True)
+                if spans:
+                    with seen_lock:
+                        seen.extend(s["span_id"] for s in spans)
+
+        dumpers = [threading.Thread(target=dumper) for _ in range(2)]
+        writers = [threading.Thread(target=writer) for _ in range(n_writers)]
+        for t in dumpers + writers:
+            t.start()
+        for t in writers:
+            t.join(30)
+        stop.set()
+        for t in dumpers:
+            t.join(10)
+        # final drain for anything recorded after the dumpers stopped
+        seen.extend(s["span_id"] for s in tracer.snapshot(clear=True))
+
+        total = n_writers * per_writer
+        assert len(seen) == total, "a clear dropped or duplicated spans"
+        assert len(set(seen)) == total  # exactly-once, no duplicates
+    finally:
+        trace.configure(enabled=False)
