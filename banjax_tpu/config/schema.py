@@ -255,8 +255,22 @@ class Config:
     # resolve-ahead depth for the fused drain commit: 2 dispatches chunk
     # i+1's window program while chunk i's events decode, overlapping the
     # fixed device->host pull instead of serializing the drain thread;
-    # 1 restores the serial drain.
+    # 1 restores the serial drain.  A no-op on the single-kernel path
+    # (pallas_single_kernel below), which has no program-B dispatch left
+    # to overlap.
     drain_resolve_depth: int = 2
+    # single-kernel fused match+window commit (matcher/kernels/
+    # fused_match_window.py): collapse the fused path's two device
+    # programs (A: stateless match, B: window commit) — and the ~65 ms
+    # host-side resolve pull between them — into ONE Pallas-anchored
+    # program whose overflow handling is gated in-kernel.  "auto"
+    # (default) turns it on when the window-scan kernel lowers for the
+    # backend (compiled Mosaic on TPU, interpret-mode on CPU — the CI
+    # path); "on" forces it (warns + falls back two-program if it can't
+    # lower); "off" pins the two-program path (the differential oracle).
+    # Note: on this path the 10 s staleness cutoff is enforced at device
+    # commit (submit) time instead of effector drain time.
+    pallas_single_kernel: str = "auto"
     # take-size bound for command batches in the pipeline's encode stage:
     # commands carry no device timing for the adaptive sizer, so a Kafka
     # command flood is chopped into batches of at most this many messages
@@ -362,7 +376,8 @@ _SCALAR_KEYS = {
     "pipeline_max_block_ms": float, "matcher_probe_seconds": float,
     "pipeline_fused": bool, "pipeline_kafka": bool,
     "encode_workers": int, "slotmgr_native": bool,
-    "drain_resolve_depth": int, "pipeline_command_take_max": int,
+    "drain_resolve_depth": int, "pallas_single_kernel": str,
+    "pipeline_command_take_max": int,
     "trace_enabled": bool, "trace_ring_size": int,
     "trace_jax_annotations": bool, "admin_token": str,
     "http_listen_host": str,
@@ -515,6 +530,11 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
         raise ValueError(
             "config key drain_resolve_depth: expected >= 1, got "
             f"{cfg.drain_resolve_depth}"
+        )
+    if cfg.pallas_single_kernel not in ("auto", "on", "off"):
+        raise ValueError(
+            "config key pallas_single_kernel: expected auto|on|off, got "
+            f"{cfg.pallas_single_kernel!r}"
         )
     if cfg.pipeline_command_take_max < 1:
         raise ValueError(

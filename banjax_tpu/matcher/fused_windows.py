@@ -40,6 +40,17 @@ Event order parity: bits are scattered into CALLER row order before the
 window apply, so the event compaction's row-major (line, rule) order — the
 reference's per-site-then-global processing order — is preserved exactly
 as in the classic path.
+
+Single-kernel mode (`pallas_single_kernel`, kernels/fused_match_window.py)
+collapses A+B into ONE program dispatched at submit: the window commit is
+gated IN-KERNEL on the overflow flags and on a device-side chain scalar
+(an overflow poisons every already-dispatched successor, which then
+replays classically in order), so the host decision between the programs
+— and with it the ~65 ms resolve pull — disappears.  submit() returns an
+already-final chunk; resolve() is a pure pull of the one combined buffer;
+staleness/abandon compose as a per-row live-mask INPUT to submit.  The
+two-program protocol below stays intact as the differential oracle and
+the fallback when the Pallas window-scan kernel can't lower.
 """
 
 from __future__ import annotations
@@ -71,7 +82,8 @@ class _Pend:
     submitted → overflow → (caller fallback) → done."""
 
     seq: int
-    sparse_buf: object     # program A's buffer (async pull in flight)
+    sparse_buf: object     # program A's buffer (async pull in flight);
+    #                        single-kernel mode: THE one combined buffer
     bits_dev: object       # [Bp, n_rules] uint8 device-resident
     slots: np.ndarray      # caller-order, pins held
     ts_s: np.ndarray       # padded to Bp
@@ -83,7 +95,9 @@ class _Pend:
     P: int
     state: str = "submitted"
     flags: Optional[np.ndarray] = None     # [4] after resolve
-    events_buf: object = None              # program B's buffer
+    events_buf: object = None              # program B's buffer, or (single-
+    #                                        kernel) the decoded host buffer
+    events_off: int = 0                    # event-record offset into it
     # decoded at resolve (from the A pull)
     matched_pairs: Optional[np.ndarray] = None
     always_bits: Optional[np.ndarray] = None
@@ -92,6 +106,15 @@ class _Pend:
     # the dense [B, n_rules] bitmap from h2d_bytes
     h2d_bytes: int = 0
     d2h_bytes: int = 0
+    # state-aware settlement: each order turn and the slot pins are
+    # released EXACTLY once no matter which combination of resolve/
+    # collect/fallback_done/abandon settles the chunk (a submit-failure
+    # abandon racing a teardown abort used to mark a turn dead twice,
+    # which could advance a counter past a live chunk's turn)
+    pins_released: bool = False
+    turns_freed: dict = dataclasses.field(
+        default_factory=lambda: {"_resolve_seq": False, "_collect_seq": False}
+    )
 
 
 @dataclasses.dataclass
@@ -128,13 +151,31 @@ class FusedWindowsPipeline:
     fallback_done() to release the order turns."""
 
     def __init__(self, prefilter: FusedPrefilter, windows: DeviceWindows,
-                 active_table, n_rules: int):
+                 active_table, n_rules: int, single_kernel: bool = False,
+                 scan_interpret: bool = True):
         self.pf = prefilter
         self.windows = windows
         self.active_table = jnp.asarray(active_table)
         self.n_rules = n_rules
         self._match_fns = {}
         self._apply_fns = {}
+        # single-kernel mode (kernels/fused_match_window.py): submit
+        # dispatches ONE program doing match + window commit (state
+        # donated, overflow/chain gated in-kernel) and the chunk is final
+        # on return; resolve/collect become pure decodes of the one
+        # async-pulled buffer.  False = the two-program A/B protocol,
+        # which stays intact as the differential oracle and the fallback
+        # when the Pallas window-scan kernel can't lower.
+        self.single_kernel = bool(single_kernel)
+        self._scan_interpret = bool(scan_interpret)
+        # device-side ok chain: each kernel's commit gates on its
+        # predecessor's ok scalar, so an overflow poisons every already-
+        # dispatched successor WITHOUT a host round-trip; None = seed the
+        # next submit with a fresh ok (no poisoned chunk outstanding)
+        self._chain_ok = None
+        self.sk_chunks = 0          # single-kernel chunks committed
+        self.sk_fallbacks = 0       # routed to the classic fallback
+        self.sk_d2h_bytes_total = 0  # the one-pull d2h witness
         plan = prefilter.plan
         self._f_idx = jnp.asarray(plan.f_idx, dtype=jnp.int32)
         self._a_idx = jnp.asarray(plan.a_idx, dtype=jnp.int32)
@@ -231,6 +272,78 @@ class FusedWindowsPipeline:
         self._match_fns[key] = (match, K, P)
         return match, K, P
 
+    # ---- single-kernel program: match + window commit in ONE dispatch ----
+
+    def _single_prog(self, Bp: int, L_p: int):
+        """The fused match+window program (single-kernel mode), cached in
+        the same per-(Bp, L_p) table as the two-program match — the modes
+        are exclusive per pipeline, so the cache never mixes kinds."""
+        key = (Bp, L_p)
+        hit = self._match_fns.get(key)
+        if hit is not None:
+            return hit
+        from banjax_tpu.matcher.kernels import fused_match_window as fmw
+
+        fn, K, P = fmw.build_single_program(
+            self.pf, self.windows, self.active_table, self.n_rules,
+            Bp, L_p, f_idx=self._f_idx, a_idx=self._a_idx,
+            aw=self._aw, ae=self._ae,
+            scan_fn=fmw.window_scan(self._scan_interpret),
+        )
+        self._match_fns[key] = (fn, K, P)
+        return fn, K, P
+
+    def _submit_single(self, combined, Bp: int, L_p: int, B: int,
+                       slots_p, ts_s_p, ts_ns_p, host_idx_p,
+                       live: Optional[np.ndarray]) -> _Pend:
+        """Dispatch the single fused program for one chunk: the window
+        state commit happens HERE (gated in-kernel on overflow and on the
+        chain scalar), so the returned chunk is already final — its
+        resolve is a pure pull.  Runs under the windows lock: maintenance
+        (evictions/restores) drains first, exactly as the two-program
+        resolve did, and the state-chain order == seq order because both
+        are taken inside the same critical section."""
+        fn, K, P = self._single_prog(Bp, L_p)
+        live_p = np.zeros(Bp, dtype=np.uint8)
+        live_p[:B] = 1 if live is None else np.asarray(live, dtype=np.uint8)
+        wnd = self.windows
+        with wnd._lock:
+            with self._cv:
+                seq = self._next_seq
+                # quiescent chain reseed: every submitted chunk resolved
+                # ⟹ every poisoned chunk's classic fallback has applied,
+                # so a fresh ok seed cannot reorder window updates
+                if seq == self._resolve_seq:
+                    self._chain_ok = None
+                self._next_seq += 1
+                chain = self._chain_ok
+            wnd._run_maintenance_locked()
+            new_state, chain_out, buf, bits_dev = fn(
+                wnd._state,
+                chain if chain is not None else jnp.int32(1),
+                jnp.asarray(combined), jnp.int32(B),
+                jnp.asarray(host_idx_p), jnp.asarray(slots_p),
+                jnp.asarray(ts_s_p), jnp.asarray(ts_ns_p),
+                jnp.asarray(live_p),
+            )
+            wnd._state = new_state
+            with self._cv:
+                self._chain_ok = chain_out
+        try:
+            buf.copy_to_host_async()
+        except AttributeError:
+            pass
+        return _Pend(
+            seq=seq, sparse_buf=buf, bits_dev=bits_dev,
+            slots=slots_p,  # caller overwrites with the unpadded view
+            ts_s=ts_s_p, ts_ns=ts_ns_p, host_idx=host_idx_p,
+            B=B, Bp=Bp, K=K, P=P,
+            # the whole h2d for the chunk: encoded classes + per-row
+            # window metadata + the live mask + the chain scalar — still
+            # no dense [B, n_rules] bitmap
+            h2d_bytes=combined.nbytes + 4 * 3 * Bp + Bp + 4,
+        )
+
     # ---- program B: window apply on a device-resident bitmap ----
 
     def _apply_prog(self, Bp: int):
@@ -281,16 +394,22 @@ class FusedWindowsPipeline:
     def submit(
         self, cls_ids: np.ndarray, lens: np.ndarray, slots: np.ndarray,
         ts_s: np.ndarray, ts_ns: np.ndarray, host_idx: np.ndarray,
+        live: Optional[np.ndarray] = None,
     ) -> _Pend:
         """Dispatch program A for one chunk (slot pins held by the caller,
         ownership passes to the pipeline). Any number of chunks may be
-        submitted ahead of their resolves."""
+        submitted ahead of their resolves.
+
+        Single-kernel mode: the ONE fused program (match + window commit,
+        overflow/chain gated in-kernel) dispatches here instead and the
+        chunk returns already final; `live` (bool [B], default all-true)
+        is the commit mask — the caller's staleness/abandon drop composed
+        as a kernel input (the two-program path takes it at resolve)."""
         pf = self.pf
         cls_ids = np.asarray(cls_ids, dtype=np.int32)
         lens = np.asarray(lens, dtype=np.int32)
         B = cls_ids.shape[0]
         combined, Bp, L_p = pf._assemble(cls_ids, lens)
-        match, K, P = self._match_prog(Bp, L_p)
 
         def pad(a, fill=0):
             a = np.asarray(a)
@@ -301,6 +420,16 @@ class FusedWindowsPipeline:
             )
 
         host_idx_p = pad(host_idx).astype(np.int32)
+        if self.single_kernel:
+            p = self._submit_single(
+                combined, Bp, L_p, B,
+                pad(np.asarray(slots, dtype=np.int32)),
+                pad(ts_s).astype(np.int32), pad(ts_ns).astype(np.int32),
+                host_idx_p, live,
+            )
+            p.slots = np.asarray(slots)
+            return p
+        match, K, P = self._match_prog(Bp, L_p)
         sparse_buf, bits_dev = match(
             jnp.asarray(combined), jnp.int32(B), jnp.asarray(host_idx_p)
         )
@@ -343,33 +472,127 @@ class FusedWindowsPipeline:
         setattr(self, attr, v)
         self._cv.notify_all()
 
-    def _advance(self, attr: str) -> None:
+    def _free_turn(self, p: _Pend, attr: str) -> None:
+        """Release one of p's order turns EXACTLY once (state-aware: a
+        chunk settled by two paths — e.g. a submit-failure abandon racing
+        a teardown abort — must not mark its turn dead twice, which
+        would leave a stale entry that could swallow a LATER chunk's
+        legitimate turn when seq numbers wrap past it)."""
         with self._cv:
-            self._sweep_locked(attr, getattr(self, attr) + 1)
+            if p.turns_freed[attr]:
+                return
+            p.turns_freed[attr] = True
+            cur = getattr(self, attr)
+            if cur == p.seq:
+                self._sweep_locked(attr, p.seq + 1)
+            else:
+                self._dead[attr].add(p.seq)
+                self._sweep_locked(attr, cur)
 
-    def _mark_dead(self, attr: str, seq: int) -> None:
-        """Free one order turn without requiring it to be current: dead
-        turns are swept the moment the counter reaches them."""
-        with self._cv:
-            self._dead[attr].add(seq)
-            self._sweep_locked(attr, getattr(self, attr))
+    def _release_chunk_pins(self, p: _Pend) -> None:
+        """Release p's slot pins exactly once.  Double release is the
+        REAL hazard the per-chunk flag closes: pins count per slot, so a
+        second decrement would release a pin held by a DIFFERENT in-
+        flight chunk on the same slot and let the LRU evict state whose
+        events are still queued."""
+        if p.pins_released:
+            return
+        p.pins_released = True
+        self.windows.release_pins(p.slots)
 
     def abandon(self, p: _Pend) -> None:
         """Settle a chunk whose apply will never run (pipeline teardown,
         a failed submit burst, or a fully-stale chunk at drain): release
-        its pins and both order turns. Safe for any not-yet-applied state —
-        program A is stateless, so an abandoned chunk leaves no trace."""
+        its pins and both order turns, each exactly once (idempotent —
+        see _free_turn/_release_chunk_pins).  Two-program mode: program A
+        is stateless, so an abandoned chunk leaves no trace.  Single-
+        kernel mode: the commit already happened at submit, so abandon
+        only settles the host-side bookkeeping (teardown paths mark the
+        chunk's lines as errors)."""
         if p.state in ("done", "failed", "resolved"):
             return
         p.state = "failed"
-        self.windows.release_pins(p.slots)
-        self._mark_dead("_resolve_seq", p.seq)
-        self._mark_dead("_collect_seq", p.seq)
+        self._release_chunk_pins(p)
+        self._free_turn(p, "_resolve_seq")
+        self._free_turn(p, "_collect_seq")
 
     def idle(self) -> bool:
         """True when no submitted chunk is awaiting its apply/collect."""
         with self._cv:
             return self._next_seq == self._collect_seq
+
+    def _decode_head(self, p: _Pend, buf: np.ndarray) -> int:
+        """Decode the match head (flags ‖ pairs ‖ always bits) shared
+        byte-for-byte by program A's buffer and the single-kernel buffer;
+        returns the offset just past it (the single-kernel event tail)."""
+        P = p.P
+        R8 = self.pf._nf8 * 8
+        flags = np.frombuffer(buf[:16].tobytes(), dtype="<i4")
+        p.flags = flags
+        off = 16
+        pairs = np.frombuffer(
+            buf[off : off + 4 * P].tobytes(), dtype="<i4"
+        )
+        off += 4 * P
+        na8 = self.pf._na8
+        if na8:
+            p.always_bits = (
+                buf[off : off + p.Bp * na8].reshape(-1, na8)[: p.B]
+            )
+            off += p.Bp * na8
+        else:
+            p.always_bits = None
+        n_pairs = int(flags[2])
+        if n_pairs <= P:
+            live_pairs = pairs[:n_pairs]
+            rows_idx = live_pairs // R8
+            cols = live_pairs - rows_idx * R8
+            # same invariant as prefilter.collect: row in range AND
+            # col within the true rule count, so matched_pairs is a
+            # clean invariant at the source (consumers may index f_idx
+            # with it directly)
+            keep = (
+                (rows_idx >= 0) & (rows_idx < p.B)
+                & (cols < self.pf.plan.stage2.n_rules)
+            )
+            p.matched_pairs = live_pairs[keep]
+        return off
+
+    def _resolve_single(self, p: _Pend) -> None:
+        """Single-kernel resolve: a PURE d2h pull — the commit already
+        happened in-kernel at submit, so all that remains is forcing the
+        (async-copied) buffer and reading the flags word.  Not-ok chunks
+        (own overflow, or gated by a poisoned predecessor) take the
+        classic fallback exactly like a two-program overflow; the resolve
+        turn is held until fallback_done, so later chunks' replays stay
+        behind this chunk's classic apply."""
+        try:
+            buf = np.asarray(p.sparse_buf)
+            p.d2h_bytes += buf.nbytes
+            off = self._decode_head(p, buf)
+            flags = p.flags
+            if not flags[0]:
+                p.state = "overflow"
+                self.fallback_batches += 1
+                self.sk_fallbacks += 1
+                raise PipelineOverflow(
+                    candidate_overflow=int(flags[1]) > p.K
+                )
+            p.events_buf = buf
+            p.events_off = off
+            p.state = "resolved"
+            self.fused_batches += 1
+            self.sk_chunks += 1
+            self.sk_d2h_bytes_total += buf.nbytes
+        except PipelineOverflow:
+            raise  # turns advance via fallback_done after the fallback
+        except Exception:
+            p.state = "failed"
+            self._release_chunk_pins(p)
+            self._free_turn(p, "_resolve_seq")
+            self._free_turn(p, "_collect_seq")
+            raise
+        self._free_turn(p, "_resolve_seq")
 
     def resolve(self, p: _Pend, live: Optional[np.ndarray] = None) -> None:
         """Order-gated: decode chunk p's A-flags; when ok, dispatch program
@@ -379,40 +602,22 @@ class FusedWindowsPipeline:
         drop composed with the deferred apply. Raises PipelineOverflow when
         the chunk must take the classic fallback; the resolve turn is NOT
         advanced until the caller completes the fallback (fallback_done),
-        keeping later chunks' applies behind this chunk's."""
+        keeping later chunks' applies behind this chunk's.
+
+        Single-kernel mode: the commit already ran at submit (live was an
+        input there); this is a pure pull + flags check — `live` must be
+        None."""
         self._wait_turn(p, "_resolve_seq")
         if p.state != "submitted":
             return
+        if self.single_kernel:
+            assert live is None, "single-kernel commit takes live at submit"
+            return self._resolve_single(p)
         try:
             buf = np.asarray(p.sparse_buf)
             p.d2h_bytes += buf.nbytes
-            P = p.P
-            R8 = self.pf._nf8 * 8
-            flags = np.frombuffer(buf[:16].tobytes(), dtype="<i4")
-            p.flags = flags
-            off = 16
-            pairs = np.frombuffer(
-                buf[off : off + 4 * P].tobytes(), dtype="<i4"
-            )
-            off += 4 * P
-            na8 = self.pf._na8
-            p.always_bits = (
-                buf[off:].reshape(-1, na8)[: p.B] if na8 else None
-            )
-            n_pairs = int(flags[2])
-            if n_pairs <= P:
-                live_pairs = pairs[:n_pairs]
-                rows_idx = live_pairs // R8
-                cols = live_pairs - rows_idx * R8
-                # same invariant as prefilter.collect: row in range AND
-                # col within the true rule count, so matched_pairs is a
-                # clean invariant at the source (consumers may index f_idx
-                # with it directly)
-                keep = (
-                    (rows_idx >= 0) & (rows_idx < p.B)
-                    & (cols < self.pf.plan.stage2.n_rules)
-                )
-                p.matched_pairs = live_pairs[keep]
+            self._decode_head(p, buf)
+            flags = p.flags
             if not flags[0]:
                 p.state = "overflow"
                 self.fallback_batches += 1
@@ -452,38 +657,53 @@ class FusedWindowsPipeline:
             # the chunk is dead: free its order turns (a stuck turn would
             # deadlock every later resolve/collect forever) and the pins.
             # The resolve turn is held by this call (current == p.seq) so
-            # _mark_dead advances it directly; the collect turn may still
+            # _free_turn advances it directly; the collect turn may still
             # belong to an EARLIER uncollected chunk and sweeps lazily.
             p.state = "failed"
-            self.windows.release_pins(p.slots)
-            self._mark_dead("_resolve_seq", p.seq)
-            self._mark_dead("_collect_seq", p.seq)
+            self._release_chunk_pins(p)
+            self._free_turn(p, "_resolve_seq")
+            self._free_turn(p, "_collect_seq")
             raise
-        self._advance("_resolve_seq")
+        self._free_turn(p, "_resolve_seq")
 
     def fallback_done(self, p: _Pend) -> None:
         """The caller's classic fallback for an overflowing chunk is fully
         applied (device + shadow + pins released by apply_bitmap): release
-        both order turns."""
+        both order turns.  The pins are marked settled so a later abandon
+        (teardown racing the fallback) cannot release them a second time."""
         p.state = "done"
-        self._advance("_resolve_seq")
-        self._advance("_collect_seq")
+        p.pins_released = True  # apply_bitmap released them
+        self._free_turn(p, "_resolve_seq")
+        self._free_turn(p, "_collect_seq")
+        if self.single_kernel:
+            # quiescent chain reseed (see _submit_single): if no later
+            # chunk is outstanding, every poisoned chunk has now applied
+            # classically, so the next submit may start a fresh ok chain
+            with self._cv:
+                if self._next_seq == self._resolve_seq:
+                    self._chain_ok = None
 
     def collect(self, p: _Pend) -> FusedWindowsResult:
         """Order-gated on the collect turn: decode chunk p's window events,
         absorb the final counter states into the host shadow, release the
         pins. Only valid for resolved chunks (collect() resolves first on
-        the serial convenience path)."""
+        the serial convenience path).  Single-kernel mode decodes the
+        event tail of the ONE buffer resolve already pulled (no second
+        d2h — the event layout is byte-identical to program B's)."""
         if p.state == "submitted":
             self.resolve(p)  # may raise PipelineOverflow to the caller
         assert p.state == "resolved", p.state
         self._wait_turn(p, "_collect_seq")
         wnd = self.windows
         try:
-            buf = np.asarray(p.events_buf)
-            p.d2h_bytes += buf.nbytes
+            if self.single_kernel:
+                buf = p.events_buf  # already host-side, pulled at resolve
+                off = p.events_off
+            else:
+                buf = np.asarray(p.events_buf)
+                p.d2h_bytes += buf.nbytes
+                off = 0
             me = wnd.max_events
-            off = 0
 
             def take_i32(n):
                 nonlocal off
@@ -538,5 +758,5 @@ class FusedWindowsPipeline:
                 always_bits=p.always_bits,
             )
         finally:
-            wnd.release_pins(p.slots)
-            self._advance("_collect_seq")
+            self._release_chunk_pins(p)
+            self._free_turn(p, "_collect_seq")

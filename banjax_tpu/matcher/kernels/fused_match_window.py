@@ -1,0 +1,268 @@
+"""Single-kernel fused match + window commit (ROADMAP item #1: kill the
+~65 ms resolve pull).
+
+The two-program fused path (matcher/fused_windows.py) splits every chunk
+into program A (stateless match + overflow flags) and program B (window
+commit) with a HOST decision between them: the drain thread pulls A's
+flags (~65 ms fixed tunnel latency), checks overflow, and only then
+dispatches B.  PRs 3-4 overlap that pull (resolve-ahead depth 2); this
+module removes it.  One device program per chunk does
+
+    match (the two-stage Pallas NFA scan, prefilter._match_core)
+      → dense caller-order bitmap + sparse (row, rule) pairs
+      → per-row live mask (staleness/abandon composed as an input)
+      → window-hit accumulation + threshold-fire against the HBM-resident
+        per-slot window state (windows._apply_core, state donated —
+        tiles of it stage through VMEM inside the scan kernel below)
+      → IN-KERNEL overflow gate: candidate / pair / event overflow (or a
+        gated predecessor, see the chain scalar) drops every state write,
+        so the donated state passes through bit-identical and the host
+        replays the chunk through the existing classic fallback
+
+and returns only a compact buffer — the [4] flags word ‖ sparse match
+pairs ‖ always-rule bits ‖ the fired-event records — plus the
+device-resident dense bitmap for the fallback.  The dense intermediate
+never crosses the host boundary, there is no inter-program host turn,
+and the drain's program-B dispatch disappears entirely: resolve becomes
+a pure d2h pull of a buffer whose async copy started at submit.
+
+Ordering without the resolve turn: program A was stateless, so the
+two-program path could submit ahead and needed the resolve-turn
+machinery to serialize B dispatches.  Here the state commit happens at
+submit, and submits are already serialized (one device thread, chunks in
+admission order), so device apply order == log order by construction.
+The overflow hazard that forced the two-program split — chunk N
+overflows, its classic re-apply would land AFTER an already-dispatched
+chunk N+1 — is closed DEVICE-SIDE by the chain scalar: every kernel
+takes its predecessor's ok flag and gates its own commit on it, so an
+overflow poisons every already-dispatched successor in-device (they
+pass state through untouched and replay classically, in order, on the
+host).  The chain reseeds once no poisoned chunk is outstanding.
+
+The window-transition recurrence runs as a Pallas kernel (`_scan_kernel`
+— the "native tier" obligation of PAPER.md §0): event records staged
+through VMEM, a fori_loop carry over the key-sorted events calling the
+SAME `windows._window_step` the XLA lax.scan lowers, so the two paths
+cannot drift.  `interpret=True` runs it as plain JAX — the CI path; the
+compiled lowering is validated by the chip-attached round
+(scripts/hw_session.sh step 4d).  `scan_selftest` proves the active
+lowering bit-identical to lax.scan at matcher construction — a failure
+downgrades the matcher to the two-program path (health-registry note).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from banjax_tpu.matcher import windows as W
+
+_SHIFTS = (0, 8, 16, 24)
+
+
+# ---- the Pallas window-scan kernel ----
+
+
+def _scan_kernel(b_ref, gh_ref, gs_ref, gn_ref, gv_ref, ts_ref, tn_ref,
+                 lim_ref, ivs_ref, ivn_ref, pad_ref,
+                 h_out, s_out, n_out, mt_out, ex_out):
+    """Sequential fixed-window recurrence over the key-sorted event list.
+
+    All refs are [1, E] int32 in VMEM (E = max_events; ~16 KB per array,
+    far under the VMEM budget, so the whole event tile is resident for
+    the scan).  The recurrence is inherently serial — a window restart
+    depends on every earlier event of the segment — so the loop carries
+    the (hits, start_s, start_ns) triple exactly like the lax.scan; the
+    body is windows._window_step itself, shared with the XLA path."""
+    E = b_ref.shape[1]
+
+    def body(k, carry):
+        xs = (
+            b_ref[0, k] != 0,
+            gh_ref[0, k], gs_ref[0, k], gn_ref[0, k],
+            gv_ref[0, k] != 0,
+            ts_ref[0, k], tn_ref[0, k],
+            lim_ref[0, k], ivs_ref[0, k], ivn_ref[0, k],
+            pad_ref[0, k] != 0,
+        )
+        carry, (h2, s1, n1, mtype, exceeded) = W._window_step(carry, xs)
+        h_out[0, k] = h2
+        s_out[0, k] = s1
+        n_out[0, k] = n1
+        mt_out[0, k] = mtype
+        ex_out[0, k] = exceeded.astype(jnp.int32)
+        return carry
+
+    jax.lax.fori_loop(
+        0, E, body, (jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _scan_call(E: int, interpret: bool):
+    shape = jax.ShapeDtypeStruct((1, E), jnp.int32)
+    return pl.pallas_call(
+        _scan_kernel,
+        out_shape=(shape, shape, shape, shape, shape),
+        interpret=interpret,
+    )
+
+
+def window_scan(interpret: bool):
+    """A `scan_fn` for windows._apply_core: same contract as the
+    lax.scan over _window_step (the recurrence always starts from the
+    zero carry, so `init` is ignored), lowered through the Pallas
+    kernel above."""
+
+    def scan(init, xs):
+        del init  # the recurrence starts from the zero carry
+        E = int(xs[0].shape[0])
+        call = _scan_call(E, bool(interpret))
+        ins = tuple(
+            jnp.asarray(x).astype(jnp.int32).reshape(1, E) for x in xs
+        )
+        h, s, n, mt, ex = call(*ins)
+        return (
+            h.reshape(E), s.reshape(E), n.reshape(E), mt.reshape(E),
+            ex.reshape(E) != 0,
+        )
+
+    return scan
+
+
+def scan_selftest(interpret: bool, E: int = 64) -> None:
+    """Prove the active scan lowering (compiled Mosaic on TPU, interpret
+    elsewhere) reproduces the lax.scan recurrence bit-for-bit on a
+    deterministic stimulus covering boundaries, pads, restarts and
+    exceeds.  Raises on a lowering failure or any value mismatch — the
+    matcher then stays on the two-program path (graceful downgrade)."""
+    rng = np.random.default_rng(7)
+    pad = np.zeros(E, dtype=bool)
+    pad[-max(1, E // 8):] = True
+    xs = (
+        jnp.asarray(rng.integers(0, 2, E).astype(bool)),     # boundary
+        jnp.asarray(rng.integers(0, 6, E).astype(np.int32)),  # g_hits
+        jnp.asarray(rng.integers(0, 40, E).astype(np.int32)),  # g_ss
+        jnp.asarray(rng.integers(0, 1000, E).astype(np.int32)),  # g_sns
+        jnp.asarray(rng.integers(0, 2, E).astype(bool)),     # g_valid
+        jnp.asarray(rng.integers(0, 60, E).astype(np.int32)),  # e_ts_s
+        jnp.asarray(rng.integers(0, 1000, E).astype(np.int32)),  # e_ts_ns
+        jnp.asarray(rng.integers(0, 4, E).astype(np.int32)),  # limit
+        jnp.asarray(rng.integers(1, 20, E).astype(np.int32)),  # iv_s
+        jnp.asarray(rng.integers(0, 1000, E).astype(np.int32)),  # iv_ns
+        jnp.asarray(pad),                                     # pad
+    )
+    init = (jnp.int32(0), jnp.int32(0), jnp.int32(0))
+    _, want = jax.lax.scan(W._window_step, init, xs)
+    got = window_scan(interpret)(init, xs)
+    for name, w, g in zip(
+        ("hits", "start_s", "start_ns", "match_type", "exceeded"), want, got
+    ):
+        if not np.array_equal(np.asarray(w), np.asarray(g)):
+            raise RuntimeError(
+                f"pallas window-scan selftest mismatch on {name!r}"
+            )
+
+
+# ---- the single fused program ----
+
+
+def build_single_program(
+    pf, windows, active_table, n_rules: int, Bp: int, L_p: int, *,
+    f_idx, a_idx, aw, ae, scan_fn,
+):
+    """One jitted device program: match core + dense bitmap assembly +
+    live mask + overflow/chain gate + window commit + compact output.
+
+    Returns (fn, K, P) where
+      fn(state, chain_ok, combined, n_real, host_idx, slots, ts_s,
+         ts_ns, live) -> (new_state, chain_ok_out, buf, bits_dev)
+    with `state` donated (the HBM-resident window arrays mutate in
+    place) and `buf` the single uint8 pull:
+
+      flags[4 × i32: ok, n_cand, n_pairs, n_events]
+      ‖ (row, rule) pairs [4P]
+      ‖ always-rule bits [Bp * na8]            (when the plan has any)
+      ‖ ev line/rule/hits/start_s/start_ns [5 × 4E]
+      ‖ ev match_type/exceeded/seen_ip [3 × E]
+
+    The layouts of the head and the event tail are byte-identical to
+    program A's and program B's buffers respectively, so the host decode
+    is shared with the two-program path."""
+    block, K = pf.capacities(Bp)
+    core = pf._match_core(Bp, L_p, K, block)
+    P = pf.pair_capacity(Bp, K)
+    plan = pf.plan
+    n_always = plan.n_always
+    n_filt = plan.stage2.n_rules
+    max_events = windows.max_events
+    limits, iv_s, iv_ns = windows._limits, windows._iv_s, windows._iv_ns
+    active_table = jnp.asarray(active_table)
+    shifts = jnp.asarray(_SHIFTS, dtype=jnp.int32)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def single(state, chain_ok, combined, n_real, host_idx, slots,
+               ts_s, ts_ns, live):
+        c = core(combined)
+        pairs, n_pairs, pair_bits = pf.pairs_from_core(c, K, P)
+        # dense caller-order bitmap, assembled on device (as program A)
+        m2 = pair_bits[:, :n_filt].astype(jnp.uint8)          # [K, n_filt]
+        filt = jnp.zeros((Bp + 1, n_filt), dtype=jnp.uint8)
+        filt = filt.at[c["idx_caller_k"]].set(m2)[:Bp]        # row Bp = dump
+        bits = jnp.zeros((Bp, n_rules), dtype=jnp.uint8)
+        bits = bits.at[:, f_idx].set(filt)
+        ab = None
+        if n_always:
+            ab = c["ab_caller"] | aw[None, :]
+            empty = (c["lens_raw"] == 0).astype(jnp.uint8)[:, None]
+            ab = ab | (ae[None, :] * empty)
+            bits = bits.at[:, a_idx].set(ab)
+        real = jax.lax.iota(jnp.int32, Bp) < n_real
+        bits = bits * real[:, None].astype(jnp.uint8)
+        # the live mask composes staleness/abandon INTO the commit: a row
+        # the caller dropped contributes no event and no state write (the
+        # returned dense bitmap stays unmasked — the classic fallback
+        # applies its own mask, exactly like the two-program path)
+        bits_live = bits * live[:, None]
+        fire = (bits_live != 0) & active_table[host_idx]
+        n_events = fire.sum(dtype=jnp.int32)
+        self_ok = (
+            (c["n_cand"] <= K) & (n_pairs <= P) & (n_events <= max_events)
+        )
+        # chain gate: a gated predecessor (overflow anywhere earlier in
+        # the submit chain) gates THIS commit too, keeping device apply
+        # order == log order across the host's classic replays
+        ok = self_ok & (chain_ok != 0)
+        new_state, ev = W._apply_core(
+            state, bits_live, active_table, host_idx, slots, ts_s, ts_ns,
+            limits, iv_s, iv_ns, n_rules=n_rules, max_events=max_events,
+            gate=ok, scan_fn=scan_fn,
+        )
+        flags = jnp.stack(
+            [ok.astype(jnp.int32), c["n_cand"], n_pairs, n_events]
+        )
+        parts = [
+            ((flags[:, None] >> shifts[None, :]) & 0xFF)
+            .astype(jnp.uint8).reshape(-1),
+            ((pairs[:, None] >> shifts[None, :]) & 0xFF)
+            .astype(jnp.uint8).reshape(-1),
+        ]
+        if n_always:
+            parts.append(
+                jnp.packbits(ab.astype(jnp.bool_), axis=1).reshape(-1)
+            )
+        for key in ("line", "rule", "hits", "start_s", "start_ns"):
+            parts.append(
+                ((ev[key][:, None] >> shifts[None, :]) & 0xFF)
+                .astype(jnp.uint8).reshape(-1)
+            )
+        parts.append(ev["match_type"].astype(jnp.uint8))
+        parts.append(ev["exceeded"].astype(jnp.uint8))
+        parts.append(ev["seen_ip"].astype(jnp.uint8))
+        return new_state, ok.astype(jnp.int32), jnp.concatenate(parts), bits
+
+    return single, K, P

@@ -23,6 +23,7 @@ flag named in BASELINE.json); CpuMatcher remains the default.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -120,6 +121,13 @@ class TpuMatcher(Matcher):
             1, int(getattr(config, "drain_resolve_depth", 2))
         )
         self.drain_resolve_overlap_ms_ewma: Optional[float] = None
+        # batches whose device-window apply is deferred to their drain
+        # turn (classic-pend fallbacks): while any is outstanding, the
+        # single-kernel path must not commit at submit (see
+        # _single_kernel_ordered) or window updates would cross batches
+        # out of admission order
+        self._drain_window_lock = threading.Lock()
+        self._drain_window_batches = 0
         self._cpu_fallback = None
         self._health_registry = health
         self._health = health.register("matcher") if health is not None else None
@@ -382,11 +390,56 @@ class TpuMatcher(Matcher):
         ):
             from banjax_tpu.matcher.fused_windows import FusedWindowsPipeline
 
+            single, scan_interpret = self._resolve_single_kernel(config)
             self._fw_pipeline = FusedWindowsPipeline(
                 self._prefilter, self.device_windows, self._active_table,
-                self.compiled.n_rules,
+                self.compiled.n_rules, single_kernel=single,
+                scan_interpret=scan_interpret,
             )
-            log.info("fused matcher+windows pipeline active")
+            log.info(
+                "fused matcher+windows pipeline active (%s)",
+                "single-kernel" if single else "two-program",
+            )
+
+    def _resolve_single_kernel(self, config) -> Tuple[bool, bool]:
+        """Resolve `pallas_single_kernel` for this backend: "auto" turns
+        the one-program fused path on whenever the Pallas window-scan
+        kernel lowers (compiled Mosaic on TPU, interpret-mode elsewhere —
+        the CI path), proven by a bit-exact selftest against the XLA
+        lax.scan.  A lowering/selftest failure downgrades gracefully to
+        the two-program path with a health-registry note, so a Mosaic
+        regression costs throughput, never correctness."""
+        sk_cfg = (getattr(config, "pallas_single_kernel", "auto") or "auto")
+        scan_interpret = bool(
+            self._pallas_interpret or jax.default_backend() != "tpu"
+        )
+        comp = (
+            self._health_registry.register("matcher-single-kernel")
+            if self._health_registry is not None else None
+        )
+        if sk_cfg == "off":
+            if comp is not None:
+                comp.ok("pallas_single_kernel: off (two-program path)")
+            return False, scan_interpret
+        try:
+            from banjax_tpu.matcher.kernels import fused_match_window
+
+            fused_match_window.scan_selftest(scan_interpret)
+        except Exception as e:  # noqa: BLE001 — downgrade, never fail the matcher
+            msg = (
+                f"single-kernel window-scan unavailable ({e}); "
+                "two-program fused path"
+            )
+            (log.warning if sk_cfg == "on" else log.info)(msg)
+            if comp is not None:
+                comp.degraded(msg)
+            return False, scan_interpret
+        if comp is not None:
+            comp.ok(
+                "single-kernel fused path active "
+                + ("(interpret scan)" if scan_interpret else "(compiled scan)")
+            )
+        return True, scan_interpret
 
     # ---- Matcher API ----
 
@@ -741,34 +794,82 @@ class TpuMatcher(Matcher):
                 state["fused_eligible"] = True
         return state
 
-    def pipeline_submit(self, state: dict) -> None:
+    # the scheduler passes its now_fn() into pipeline_submit when this
+    # attribute is set — the single-kernel path commits window state at
+    # submit, so the staleness live mask is evaluated HERE (deterministic
+    # under an injected clock), not at drain
+    pipeline_submit_takes_now = True
+
+    def pipeline_submit(self, state: dict, now: Optional[float] = None) -> None:
         if not len(state["work"]):
             return
-        if state.get("fused_eligible"):
-            if self._submit_fused_pipeline(state):
+        if state.get("fused_eligible") and self._single_kernel_ordered():
+            if self._submit_fused_pipeline(state, now):
                 return
         state["pend"] = self._match_bits_submit(state["work"], state["pre"])
+        if self.device_windows is not None:
+            # this batch's window apply happens at ITS drain turn: gate
+            # later single-kernel commits (which happen at submit, i.e.
+            # EARLIER than this batch's drain) until it completes, or
+            # cross-batch window updates would reorder
+            with self._drain_window_lock:
+                self._drain_window_batches += 1
+            state["window_at_drain"] = True
 
-    def _submit_fused_pipeline(self, state: dict) -> bool:
-        """Dispatch program A for every chunk of the batch (two-phase
+    def _single_kernel_ordered(self) -> bool:
+        """Commit-at-submit is only order-safe while no EARLIER admitted
+        batch still owes a drain-time window apply (a classic-pend
+        fallback from slot refusal or host-eval rows).  While one is
+        outstanding, this batch joins the classic path too — the single
+        drain thread then applies everything in admission order.  The
+        two-program mode commits at drain anyway, so it never gates."""
+        fw = self._fw_pipeline
+        if fw is None or not fw.single_kernel:
+            return True
+        with self._drain_window_lock:
+            return self._drain_window_batches == 0
+
+    def _submit_fused_pipeline(self, state: dict,
+                               now: Optional[float] = None) -> bool:
+        """Dispatch the device program(s) for every chunk of the batch.
+        Two-program mode dispatches program A (stateless match) per chunk;
+        single-kernel mode dispatches the ONE fused match+window program —
+        the chunk is final on return, and the 10 s staleness cutoff is
+        applied here as the kernel's live-mask input (`now`, from the
+        scheduler's clock; falls back to wall time on the direct-call
         path).  Returns False — with every partial entry abandoned — when
         slot allocation refuses, so the caller falls back to the classic
         bitmap protocol for this batch.  Any other failure abandons the
         entries and re-raises (the scheduler then drains the batch
-        generically; program A is stateless, so nothing double-applies)."""
+        generically; program A is stateless so nothing double-applies —
+        on the single-kernel path an already-committed chunk's generic
+        rerun can double-count window hits, never Banner effects)."""
         failpoints.check("matcher.device")
         work = state["work"]
         cls_ids, lens, _ = state["pre"]
+        fw = self._fw_pipeline
+        sk = fw.single_kernel
+        # one fused span replaces the program-a (submit) / program-b
+        # (drain) pair: match and window commit are one dispatch now
+        span_name = "program-ab-fused" if sk else "program-a"
+        if sk and now is None:
+            now = time.time()
         entries = []
         try:
             for s in range(0, len(work), self._max_batch):
-                # child of the scheduler's ambient `submit` span: one
-                # program-A (stateless match) dispatch per chunk
-                with trace.span("program-a", args={"row0": s}):
+                wc = work[s : s + self._max_batch]
+                live = stale = None
+                if sk:
+                    ages_s = now - wc.ts_array() / 1e9
+                    st = ages_s > OLD_LINE_CUTOFF_SECONDS
+                    if st.any():
+                        stale, live = st, ~st
+                with trace.span(span_name, args={"row0": s}):
                     e = self._submit_pipeline_chunk(
-                        work[s : s + self._max_batch],
+                        wc,
                         cls_ids[s : s + self._max_batch],
                         lens[s : s + self._max_batch],
+                        live=live,
                     )
                 if e is None:
                     # more distinct IPs than free+unpinned slots (in-flight
@@ -777,6 +878,8 @@ class TpuMatcher(Matcher):
                         self._fw_pipeline.abandon(prev["pend"])
                     return False
                 e["row0"] = s
+                e["live"] = live
+                e["stale"] = stale
                 entries.append(e)
         except Exception:
             for prev in entries:
@@ -813,12 +916,21 @@ class TpuMatcher(Matcher):
         later batches' resolves can't deadlock.  Idempotent."""
         entries = state.get("fused")
         state["fused"] = None
+        self._drain_window_done(state)
         if entries:
             for e in entries:
                 try:
                     self._fw_pipeline.abandon(e["pend"])
                 except Exception:  # noqa: BLE001 — abort must settle every entry
                     log.exception("fused pipeline abandon failed")
+
+    def _drain_window_done(self, state: dict) -> None:
+        """Release one drain-time window-apply slot exactly once per
+        batch (pipeline_finish's finally AND pipeline_abort may both
+        run for a failing batch)."""
+        if state.pop("window_at_drain", False):
+            with self._drain_window_lock:
+                self._drain_window_batches -= 1
 
     def pipeline_finish(self, state: dict, now: float):
         """Drain stage: staleness re-check at EFFECTOR DRAIN time (the
@@ -833,6 +945,16 @@ class TpuMatcher(Matcher):
         try:
             if not len(work):
                 return results, 0
+            if (
+                state.get("fused") is not None
+                and self._fw_pipeline.single_kernel
+            ):
+                # single-kernel chunks committed at submit (live mask =
+                # submit-time staleness): the drain is pure event pull +
+                # replay, no program-B dispatch, no drain-time re-cut
+                n_stale = self._finish_single_kernel(state, results)
+                self._note_health()
+                return results, n_stale
             ages_s = now - work.ts_array() / 1e9
             stale = ages_s > OLD_LINE_CUTOFF_SECONDS
             if stale.any():
@@ -859,6 +981,7 @@ class TpuMatcher(Matcher):
             self._note_health()
             return results, n_stale
         finally:
+            self._drain_window_done(state)
             self.stats.record_batch(
                 len(state["lines"]), time.perf_counter() - t0
             )
@@ -988,6 +1111,84 @@ class TpuMatcher(Matcher):
                 head = pending.pop(0)
                 collect_replay(head, overlapped=bool(pending))
         drain_pending()
+
+    def _finish_single_kernel(self, state, results) -> int:
+        """Ordered drain for single-kernel chunks: the window commit
+        already ran in-kernel at submit (the live mask carried the
+        submit-time 10 s staleness cut), so each chunk's drain is a pure
+        d2h pull (async since submit) + decode + Banner replay —
+        `drain_resolve_depth` is a no-op here because there is no
+        program-B dispatch left to overlap.  Overflow / chain-gated
+        chunks replay classically in chunk order via the existing
+        fallback (their kernel committed nothing — the in-kernel gate)."""
+        from banjax_tpu.matcher.fused_windows import PipelineOverflow
+
+        entries = state["fused"]
+        state["fused"] = None
+        fw = self._fw_pipeline
+        n_stale = 0
+        for e in entries:
+            stale = e.get("stale")
+            live = e.get("live")
+            if stale is not None:
+                n_stale += int(stale.sum())
+                for k in np.flatnonzero(stale):
+                    i, _ = e["work"][int(k)]
+                    r = results[i]
+                    r.old_line = True
+                    r.rule_results = []
+            chunk_stale = (
+                stale if stale is not None
+                else np.zeros(len(e["work"]), dtype=bool)
+            )
+            e["chunk_stale"] = chunk_stale
+            pend = e["pend"]
+            try:
+                failpoints.check("matcher.resolve")
+                fw.resolve(pend)
+            except PipelineOverflow as ov:
+                trace.instant("fused-overflow-fallback", {"row0": e["row0"]})
+                self.pipelined_fused_fallbacks += 1
+                try:
+                    self._pipeline_fallback_entry(e, ov, results, live=live)
+                except Exception:  # noqa: BLE001 — one chunk's loss, not the stream's
+                    log.exception(
+                        "single-kernel overflow fallback failed; chunk "
+                        "lines marked error"
+                    )
+                    self._mark_chunk_error(e, chunk_stale, results)
+                    self.note_device_outcome(0.0, ok=False)
+                self.stats.note_xfer(pend.h2d_bytes, pend.d2h_bytes)
+                continue
+            except Exception:  # noqa: BLE001 — a dead chunk must not wedge the drain
+                if pend.state == "submitted":
+                    fw.abandon(pend)
+                log.exception(
+                    "single-kernel event pull failed; chunk lines marked "
+                    "error"
+                )
+                self._mark_chunk_error(e, chunk_stale, results)
+                self.note_device_outcome(0.0, ok=False)
+                continue
+            with trace.span("effector-replay", args={"row0": e["row0"]}):
+                try:
+                    res = fw.collect(pend)
+                    self._replay_window_events(
+                        e["work"], None,
+                        (res.matched_pairs, res.always_bits),
+                        res.events, results, live_rows=live,
+                    )
+                    self.pipelined_fused_chunks += 1
+                except Exception:  # noqa: BLE001 — collect settled pins/turns in finally
+                    log.exception(
+                        "single-kernel event collect failed; chunk lines "
+                        "marked error"
+                    )
+                    self._mark_chunk_error(e, chunk_stale, results)
+                    self.note_device_outcome(0.0, ok=False)
+                finally:
+                    self.stats.note_xfer(pend.h2d_bytes, pend.d2h_bytes)
+        return n_stale
 
     def _mark_chunk_error(self, e, chunk_stale, results) -> None:
         for k in np.flatnonzero(~chunk_stale):
@@ -1325,9 +1526,11 @@ class TpuMatcher(Matcher):
                 log.exception("pipeline drain after failure also failed")
             raise
 
-    def _submit_pipeline_chunk(self, work, cls_ids, lens):
-        """Allocate slots + dispatch program A for one chunk; None when
-        slot allocation refuses. Pins transfer to the pipeline on success."""
+    def _submit_pipeline_chunk(self, work, cls_ids, lens, live=None):
+        """Allocate slots + dispatch the chunk's device program (A, or
+        the single fused kernel — `live` is its commit mask); None when
+        slot allocation refuses. Pins transfer to the pipeline on
+        success."""
         from banjax_tpu.matcher.windows import split_ns
 
         dw = self.device_windows
@@ -1338,7 +1541,7 @@ class TpuMatcher(Matcher):
             ts_s, ts_ns = split_ns(work.ts_array())
             host_idx = work.host_idx(self._host_row)
             pend = self._fw_pipeline.submit(
-                cls_ids, lens, slots, ts_s, ts_ns, host_idx
+                cls_ids, lens, slots, ts_s, ts_ns, host_idx, live=live
             )
         except Exception:
             dw.release_pins(slots)

@@ -109,6 +109,39 @@ def _count_events(bits, active_table, host_idx):
     return fire.sum(dtype=jnp.int32)
 
 
+def _window_step(carry, xs):
+    """One event of the fixed-window recurrence (rate_limit.go:37-78 with
+    the reset-to-0-on-exceed quirk), segment boundaries reloading the
+    persistent state.  Module-level and pure on purpose: the XLA
+    `lax.scan` below and the Pallas single-kernel scan
+    (kernels/fused_match_window.py) both lower from THIS definition, so
+    the two paths cannot drift semantically."""
+    c_hits, c_ss, c_sns = carry
+    (b, gh, gs, gn, gv, ets, etn, lim, ivs, ivn, is_pad) = xs
+    h0 = jnp.where(b, gh, c_hits)
+    s0 = jnp.where(b, gs, c_ss)
+    n0 = jnp.where(b, gn, c_sns)
+    have = jnp.where(b, gv, True)
+
+    ds, dns = _pair_sub(ets, etn, s0, n0)
+    outside = have & _pair_gt(ds, dns, ivs, ivn)
+    restart = ~have | outside
+    h1 = jnp.where(restart, jnp.int32(1), h0 + 1)
+    s1 = jnp.where(restart, ets, s0)
+    n1 = jnp.where(restart, etn, n0)
+    exceeded = h1 > lim
+    h2 = jnp.where(exceeded, jnp.int32(0), h1)
+    mtype = jnp.where(
+        ~have, jnp.int32(0), jnp.where(outside, jnp.int32(1), jnp.int32(2))
+    )
+    # padding events must not perturb the carry (they share key cap_r,
+    # so they're their own segment — but keep them inert regardless)
+    h2 = jnp.where(is_pad, c_hits, h2)
+    s1 = jnp.where(is_pad, c_ss, s1)
+    n1 = jnp.where(is_pad, c_sns, n1)
+    return (h2, s1, n1), (h2, s1, n1, mtype, exceeded)
+
+
 def _apply_core(
     state: DeviceWindowState,
     bits: jnp.ndarray,         # [B, R] uint8/bool match bitmap (device)
@@ -124,6 +157,7 @@ def _apply_core(
     n_rules: int,
     max_events: int,
     gate=None,                 # scalar bool: False drops EVERY state write
+    scan_fn=None,              # None = lax.scan over _window_step
 ):
     """The traceable window-apply body — composable inside a larger jit
     (the fused matcher+windows pipeline) as well as the standalone
@@ -131,7 +165,11 @@ def _apply_core(
     (_maintenance_step). `gate` supports overflow handling under buffer
     donation: when False, all scatters drop (indices pushed out of range)
     so the donated state passes through bit-identical and the caller can
-    rerun the batch through the splitting path — no state copy needed."""
+    rerun the batch through the splitting path — no state copy needed.
+    `scan_fn(init, xs) -> (f_hits, f_ss, f_sns, mtype, exceeded)` swaps
+    the event recurrence for an alternative lowering of _window_step —
+    the single-kernel path passes the Pallas scan from
+    kernels/fused_match_window.py; None keeps the XLA lax.scan."""
     cap_r = state.hits.shape[0]
     valid = state.valid
     ip_seen = state.ip_seen
@@ -177,38 +215,17 @@ def _apply_core(
     ivs_e = iv_s[rules_s]
     ivns_e = iv_ns[rules_s]
 
-    def step(carry, xs):
-        c_hits, c_ss, c_sns = carry
-        (b, gh, gs, gn, gv, ets, etn, lim, ivs, ivn, is_pad) = xs
-        h0 = jnp.where(b, gh, c_hits)
-        s0 = jnp.where(b, gs, c_ss)
-        n0 = jnp.where(b, gn, c_sns)
-        have = jnp.where(b, gv, True)
-
-        ds, dns = _pair_sub(ets, etn, s0, n0)
-        outside = have & _pair_gt(ds, dns, ivs, ivn)
-        restart = ~have | outside
-        h1 = jnp.where(restart, jnp.int32(1), h0 + 1)
-        s1 = jnp.where(restart, ets, s0)
-        n1 = jnp.where(restart, etn, n0)
-        exceeded = h1 > lim
-        h2 = jnp.where(exceeded, jnp.int32(0), h1)
-        mtype = jnp.where(
-            ~have, jnp.int32(0), jnp.where(outside, jnp.int32(1), jnp.int32(2))
-        )
-        # padding events must not perturb the carry (they share key cap_r,
-        # so they're their own segment — but keep them inert regardless)
-        h2 = jnp.where(is_pad, c_hits, h2)
-        s1 = jnp.where(is_pad, c_ss, s1)
-        n1 = jnp.where(is_pad, c_sns, n1)
-        return (h2, s1, n1), (h2, s1, n1, mtype, exceeded)
-
     init = (jnp.int32(0), jnp.int32(0), jnp.int32(0))
     xs = (
         boundary, g_hits, g_ss, g_sns, g_valid,
         e_ts_s, e_ts_ns, lim_e, ivs_e, ivns_e, pad_s,
     )
-    _, (f_hits, f_ss, f_sns, mtype, exceeded) = jax.lax.scan(step, init, xs)
+    if scan_fn is None:
+        _, (f_hits, f_ss, f_sns, mtype, exceeded) = jax.lax.scan(
+            _window_step, init, xs
+        )
+    else:
+        f_hits, f_ss, f_sns, mtype, exceeded = scan_fn(init, xs)
 
     # 4. write back each segment's final state (last event of each key)
     next_key = jnp.concatenate([key_s[1:], jnp.full((1,), -2, dtype=key_s.dtype)])

@@ -159,6 +159,19 @@ FAMILIES: List[Family] = [
     Family(GAUGE, "EWMA event-decode+replay ms hidden behind the next "
            "chunk's window program", line_key="DrainResolveOverlapMs",
            prom="banjax_drain_resolve_overlap_ms"),
+    # ---- single-kernel fused path (kernels/fused_match_window.py) ----
+    Family(COUNTER, "chunks committed by the single-kernel fused "
+           "match+window program (one dispatch, one pull)",
+           line_key="SingleKernelChunks",
+           prom="banjax_single_kernel_chunks_total"),
+    Family(COUNTER, "single-kernel chunks routed to the classic replay "
+           "(in-kernel overflow or chain gate)",
+           line_key="SingleKernelFallbacks",
+           prom="banjax_single_kernel_fallbacks_total"),
+    Family(GAUGE, "d2h bytes per committed single-kernel chunk (the "
+           "one-pull witness: flags + pairs + events in ONE buffer)",
+           line_key="SingleKernelD2hBytesPerBatch",
+           prom="banjax_single_kernel_d2h_bytes_per_batch"),
     # ---- breaker / degraded mode ----
     Family(GAUGE, "circuit breaker state (one-hot by state label)",
            line_key="MatcherBreakerState",

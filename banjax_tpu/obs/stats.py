@@ -163,6 +163,16 @@ class MatcherStats:
                 out["DrainResolveOverlapMs"] = _r3(
                     getattr(matcher, "drain_resolve_overlap_ms_ewma", None)
                 )
+                # single-kernel fused path: one program, one pull per
+                # chunk — the resolve-pull elimination is visible as
+                # SingleKernelChunks rising while DrainResolveOverlapMs
+                # stays unset (nothing left for depth-2 to hide)
+                if getattr(fw, "single_kernel", False):
+                    out["SingleKernelChunks"] = fw.sk_chunks
+                    out["SingleKernelFallbacks"] = fw.sk_fallbacks
+                    out["SingleKernelD2hBytesPerBatch"] = round(
+                        fw.sk_d2h_bytes_total / max(1, fw.sk_chunks), 1
+                    )
             # circuit breaker (resilience/breaker.py): the one place all
             # the ad-hoc fallback counters roll up for operators —
             # nonzero MatcherCpuFallbackBatches = batches served in

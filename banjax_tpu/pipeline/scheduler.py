@@ -37,6 +37,16 @@ test_fused_pipeline_differential.py proves byte-identity and the h2d
 win).  Generic drains use consume_lines_serial so an inline fused burst
 can't deadlock against in-flight two-phase order turns.
 
+Single-kernel mode (`pallas_single_kernel`, the default where the Pallas
+window-scan kernel lowers): match AND window commit are ONE device
+program dispatched at the submit stage — the drain stage loses its
+program-B dispatch turn entirely and just pulls each chunk's compact
+event buffer (async since submit) in admission order.  Because the
+commit happens at submit, the 10 s staleness cutoff is evaluated there
+(the kernel's live-mask input), which is why the submit call below
+receives the scheduler clock; a matcher advertises this with
+`pipeline_submit_takes_now`.
+
 Kafka commands: submit_commands() admits command messages into the SAME
 buffer as tailer lines — shared bounded-block/oldest-first-shed
 accounting (admitted == processed + shed spans both producers) — and
@@ -536,7 +546,22 @@ class PipelineScheduler:
                                 "submit", batch.trace_id,
                                 parent=batch.root_span.span_id,
                             ), trace.step_annotation(batch.trace_id):
-                                batch.matcher.pipeline_submit(batch.state)
+                                # matchers that commit state at submit
+                                # (the single-kernel fused path) take the
+                                # scheduler clock so the staleness cut
+                                # stays deterministic under an injected
+                                # now_fn
+                                if getattr(
+                                    batch.matcher,
+                                    "pipeline_submit_takes_now", False,
+                                ):
+                                    batch.matcher.pipeline_submit(
+                                        batch.state, now=self._now_fn()
+                                    )
+                                else:
+                                    batch.matcher.pipeline_submit(
+                                        batch.state
+                                    )
                             # submit half of the device time; collect adds
                             # its half (NOT wall-from-submit: with depth-2
                             # overlap that would double-count the gap where

@@ -48,6 +48,13 @@ one-line JSON schema, and merge both rows (plus the speedup) into
 BENCH_pipeline.json.  Knobs: BENCH_STREAM_{RULES,LINES,CHUNK,BUDGET_MS},
 BENCH_CPU=1 for the host backend.
 
+Single-kernel mode: `bench.py --single-kernel` A/Bs the one-program
+fused match+window path (pallas_single_kernel on — one dispatch, one
+pull, no program-B turn) against the two-program A/B path on the
+--fused-pipeline stream shape, banking lines/s, d2h bytes/batch and
+the resolve-pull elimination into BENCH_single_kernel.json.  Knobs:
+the BENCH_STREAM_* set, BENCH_CPU=1 for the host backend.
+
 Host-parallel mode: `bench.py --host-parallel` A/Bs the sharded
 encode-worker pool (workers 0 vs N) and the native slot manager (C vs
 Python dict) at the all-distinct-IP host worst case, merging
@@ -1364,6 +1371,177 @@ def _fused_pipeline_mode() -> None:
     print(json.dumps(book))
 
 
+SINGLE_KERNEL_PATH = os.path.join(_DIR, "BENCH_single_kernel.json")
+
+
+def _single_kernel_mode() -> None:
+    """`bench.py --single-kernel`: the streaming pipeline + device
+    windows with the single-kernel fused program ON (one dispatch, one
+    pull, no program-B turn) vs OFF (the two-program A/B path with its
+    depth-2 resolve-ahead), same chunk stream.  Banks both rows into
+    BENCH_single_kernel.json with the acceptance witnesses: lines/s (the
+    on-row must match or beat the banked --fused-pipeline row), d2h
+    bytes/batch (one combined buffer vs A+B pulls), and the resolve-pull
+    elimination — the off-row's DrainResolveOverlapMs is the decode+
+    replay wall the two-program drain hides behind program B; the on-row
+    has no B left to hide behind, so the metric stays unset (≈ 0)."""
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import yaml as _yaml
+
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from banjax_tpu.pipeline import PipelineScheduler
+    from tests.mock_banner import MockBanner
+
+    backend = jax.devices()[0].platform
+    n_rules = int(os.environ.get("BENCH_STREAM_RULES", str(N_RULES)))
+    total = int(os.environ.get(
+        "BENCH_STREAM_LINES", "131072" if backend == "tpu" else "16384"
+    ))
+    feed_chunk = int(os.environ.get("BENCH_STREAM_CHUNK", "256"))
+    budget_ms = float(os.environ.get("BENCH_STREAM_BUDGET_MS", "180"))
+
+    patterns = generate_rules(n_rules)
+    rules_yaml = _yaml.safe_dump({
+        "regexes_with_rates": [
+            {"rule": f"crs{i}", "regex": p, "interval": 60,
+             "hits_per_interval": 50, "decision": "nginx_block"}
+            for i, p in enumerate(patterns)
+        ]
+    })
+    now = time.time()
+    rests = generate_lines(total, patterns, seed=47)
+    lines = [
+        f"{now:.6f} 10.7.{(i % 2048) >> 8}.{i % 256} {r}"
+        for i, r in enumerate(rests)
+    ]
+    chunks = [lines[i : i + feed_chunk] for i in range(0, total, feed_chunk)]
+
+    rows = {}
+    for label, mode in (("single_kernel", "on"), ("two_program", "off")):
+        cfg = config_from_yaml_text(rules_yaml)
+        cfg.matcher_device_windows = True
+        cfg.pallas_single_kernel = mode
+        matcher = TpuMatcher(
+            cfg, MockBanner(), StaticDecisionLists(cfg),
+            RegexRateLimitStates(),
+        )
+        fw = matcher._fw_pipeline
+        assert fw is not None, "fused matcher+windows pipeline missing"
+        assert fw.single_kernel == (mode == "on"), (
+            f"pallas_single_kernel={mode} did not resolve as requested "
+            "(Pallas window-scan unavailable on this backend?)"
+        )
+        sched = PipelineScheduler(
+            lambda: matcher, latency_budget_ms=budget_ms,
+            buffer_lines=max(131072, total), now_fn=lambda: now,
+        )
+        sched.start()
+        # warm until compiles AND the adaptive sizer settle: the
+        # single-kernel program compiles one bigger variant per (rows,
+        # line-len) bucket than A/B, and a first-visit compile poisons
+        # the sizer's per-line record for that bucket until its decay
+        # retry (sizer._RETRY_BLOCKED) — one warm pass would bank the
+        # convergence transient, not steady state.  Fixed pass count
+        # (non-adaptive: the transient plateaus, so a rate-delta exit
+        # fires early); both rows use the identical protocol.
+        warm_passes = int(os.environ.get("BENCH_SK_WARM_PASSES", "6"))
+        for _ in range(max(1, warm_passes)):
+            for c in chunks:
+                sched.submit(c)
+            assert sched.flush(600), f"{label} warm pass did not drain"
+        # several timed passes, best banked: on the 1-core build box the
+        # adaptive sizer's trajectory wobbles batch sizes between passes
+        # (PERF round 9 measured 6.6% run-to-run spread on this exact
+        # workload) — the best pass is the steady-state estimate, the
+        # full list is kept for the spread
+        timed_passes = int(os.environ.get("BENCH_SK_TIMED_PASSES", "3"))
+        pass_rates = []
+        h2d0 = matcher.stats.h2d_bytes_total
+        d2h0 = matcher.stats.d2h_bytes_total
+        batches0 = matcher.stats.batches_total
+        elapsed_total = 0.0
+        for _ in range(max(1, timed_passes)):
+            t0 = time.perf_counter()
+            for c in chunks:
+                sched.submit(c)
+            assert sched.flush(600), f"{label} timed pass did not drain"
+            dt = time.perf_counter() - t0
+            elapsed_total += dt
+            pass_rates.append(round(total / dt, 1))
+        snap = sched.snapshot()
+        sched.stop()
+        overlap = matcher.drain_resolve_overlap_ms_ewma
+        rows[label] = {
+            "mode": f"pipeline+device_windows ({label})",
+            "backend": backend,
+            "value": max(pass_rates),
+            "unit": "lines/sec",
+            "vs_baseline": round(max(pass_rates) / TARGET, 4),
+            "pass_rates": pass_rates,
+            "elapsed_s": round(elapsed_total, 2),
+            "n_rules": n_rules,
+            "n_lines": total,
+            "h2d_bytes_per_batch": round(
+                (matcher.stats.h2d_bytes_total - h2d0)
+                / max(1, matcher.stats.batches_total - batches0), 1
+            ),
+            "d2h_bytes_per_batch": round(
+                (matcher.stats.d2h_bytes_total - d2h0)
+                / max(1, matcher.stats.batches_total - batches0), 1
+            ),
+            "pipelined_fused_chunks": matcher.pipelined_fused_chunks,
+            "pipelined_fused_fallbacks": matcher.pipelined_fused_fallbacks,
+            "single_kernel_chunks": fw.sk_chunks,
+            "single_kernel_fallbacks": fw.sk_fallbacks,
+            "drain_resolve_overlap_ms": (
+                None if overlap is None else round(overlap, 3)
+            ),
+            "pipeline_batches": snap.get("PipelineBatches"),
+            "pipeline_shed_lines": snap.get("PipelineShedLines"),
+        }
+
+    banked_fused = None
+    try:
+        with open(FUSED_STREAM_PATH) as f:
+            banked_fused = json.load(f).get("fused", {}).get("value")
+    except (OSError, ValueError):
+        pass
+    on, off = rows["single_kernel"], rows["two_program"]
+    book = {
+        "metric": "log-lines/sec, streaming pipeline + device windows "
+                  "(single-kernel fused program vs two-program A/B)",
+        "single_kernel": on,
+        "two_program": off,
+        "single_vs_two_program_speedup": round(
+            on["value"] / max(1.0, off["value"]), 3
+        ),
+        # the resolve-pull witness: the off row's drain hides this many
+        # ms of decode+replay behind program B per chunk; the on row has
+        # no B dispatch — the pull is GONE from the drain critical path,
+        # not overlapped (DrainResolveOverlapMs ≈ 0 / unset)
+        "resolve_pull_ms_eliminated": off["drain_resolve_overlap_ms"],
+        "resolve_pull_removed": on["drain_resolve_overlap_ms"] in (None, 0),
+        # acceptance vs the banked --fused-pipeline row (same stream
+        # shape): >= 1.0 means the single-kernel row matches or beats it
+        "banked_fused_pipeline_lines_per_sec": banked_fused,
+        "vs_banked_fused_pipeline": (
+            None if not banked_fused
+            else round(on["value"] / banked_fused, 3)
+        ),
+    }
+    tmp = SINGLE_KERNEL_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(book, f, indent=1)
+    os.replace(tmp, SINGLE_KERNEL_PATH)
+    print(json.dumps(book))
+
+
 def _stream_mode(mode: str) -> None:
     """End-to-end throughput of the tailer→matcher path under a
     tailer-shaped feed.
@@ -1603,6 +1781,9 @@ def main() -> None:
         return
     if "--fused-pipeline" in sys.argv:
         _fused_pipeline_mode()
+        return
+    if "--single-kernel" in sys.argv:
+        _single_kernel_mode()
         return
     if "--pipeline" in sys.argv:
         _stream_mode("pipeline")

@@ -82,6 +82,19 @@ timeout 900 python bench.py --fused-pipeline || true
 BENCH_STREAM_DEVICE_WINDOWS=1 timeout 900 python bench.py --pipeline || true
 timeout 600 python __graft_entry__.py || true
 
+# 4d. single-kernel fused A/B (one-program match+window commit vs the
+# two-program A/B path, device windows on): banks lines/s, d2h
+# bytes/batch and the resolve-pull elimination into
+# BENCH_single_kernel.json — the ROADMAP chip-attached round reads the
+# on-row against the banked --fused-pipeline row and checks
+# DrainResolveOverlapMs stays unset (no program-B dispatch left to
+# overlap). Also the first compiled-Mosaic exercise of the Pallas
+# window-scan kernel: a lowering failure shows up as the on-row
+# asserting (single-kernel did not resolve) — the matcher itself
+# degrades to two-program with a health note, so it costs the row, not
+# correctness.
+timeout 1200 python bench.py --single-kernel || true
+
 # 4c. host-parallel A/B (sharded encode workers + native slot manager):
 # banks the multi-core chip-host row into BENCH_host_parallel.json next
 # to the 1-core CI row (rows are keyed by core count, so neither

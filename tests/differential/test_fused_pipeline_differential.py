@@ -177,7 +177,11 @@ def test_h2d_witness_dense_reupload_gone_at_rule_scale():
 def test_overflow_chunks_interleaved_with_ok_chunks():
     """Bursts of all-matching traffic (candidate overflow → classic
     mid-pipeline replay) interleaved with benign chunks: byte-identical,
-    fallbacks counted, pins/turns never leak (the flush would hang)."""
+    fallbacks counted, pins/turns never leak (the flush would hang).
+    Two-program path pinned — its resolve turns let benign chunks BEHIND
+    an overflow still commit fused, which the chunk-counter assertions
+    below encode; the single-kernel chain-gate composition of this shape
+    lives in tests/differential/test_single_kernel_differential.py."""
     now = time.time()
     rng = random.Random(3)
     lines = []
@@ -192,10 +196,10 @@ def test_overflow_chunks_interleaved_with_ok_chunks():
         else:
             lines += _gen_lines(40, now, seed=100 + burst)
 
-    sync, _, _, sync_log = _build(TpuMatcher)
+    sync, _, _, sync_log = _build(TpuMatcher, pallas_single_kernel="off")
     sync_results = sync.consume_lines(lines, now_unix=now)
 
-    pipe, _, _, pipe_log = _build(TpuMatcher)
+    pipe, _, _, pipe_log = _build(TpuMatcher, pallas_single_kernel="off")
     pipe_results, _ = _run_pipelined(pipe, lines, now, sizer_seed=5)
 
     assert [result_key(r) for r in pipe_results] == \
@@ -284,9 +288,11 @@ def test_drain_stale_composes_with_deferred_commit():
     """Lines that age past the 10 s cutoff while queued are dropped at
     the drain commit via the live mask: no window update, no Banner
     effect, marked old_line — while fresh lines in the SAME chunk commit
-    normally."""
+    normally.  (Two-program path pinned: the single-kernel path takes
+    the staleness cut at submit instead — see
+    tests/differential/test_single_kernel_differential.py.)"""
     now = time.time()
-    m, states, _, ban_log = _build(TpuMatcher)
+    m, states, _, ban_log = _build(TpuMatcher, pallas_single_kernel="off")
     # 8 s old at encode (fresh), drained at now+3 → 11 s old → stale
     old = [
         f"{now - 8:f} 9.9.9.{i} GET per-site.com GET /blockme HTTP/1.1 ua -"

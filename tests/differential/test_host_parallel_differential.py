@@ -241,16 +241,21 @@ def test_resolve_ahead_depth_byte_identical(small_shards, depth):
     now = time.time()
     lines = _gen_lines(1200, now, seed=31)
 
-    sync, _, _, sync_log = _build(TpuMatcher, True)
+    sync, _, _, sync_log = _build(
+        TpuMatcher, True, pallas_single_kernel="off"
+    )
     sync_results = sync.consume_lines(lines, now_unix=now)
 
     # cand_frac=1.0: small (64-line) chunks must not overflow the
     # prefilter's candidate capacity — this test wants the two-phase
-    # commit, not the fallback (that composition is tested below)
+    # commit, not the fallback (that composition is tested below).
+    # pallas_single_kernel=off: resolve-ahead is the TWO-PROGRAM drain's
+    # machinery (the single-kernel path has no program-B dispatch left
+    # to overlap, so the overlap metric legitimately stays unset there).
     par, _, _, par_log = _build(
         TpuMatcher, True,
         matcher_batch_lines=64, drain_resolve_depth=depth,
-        matcher_prefilter_cand_frac=1.0,
+        matcher_prefilter_cand_frac=1.0, pallas_single_kernel="off",
     )
     par_results, _ = _run_pipelined(
         par, lines, now, workers=0, sizer=BigSizer(seed=7)
@@ -286,7 +291,7 @@ def test_depth2_with_stale_and_overflow(small_shards):
 
     d1, _, _, d1_log = _build(
         TpuMatcher, True, matcher_batch_lines=64, drain_resolve_depth=1,
-        matcher_prefilter_cand_frac=0.5,
+        matcher_prefilter_cand_frac=0.5, pallas_single_kernel="off",
     )
     d1_results, _ = _run_pipelined(
         d1, lines, now, workers=0, sizer=BigSizer(seed=3)
@@ -294,7 +299,7 @@ def test_depth2_with_stale_and_overflow(small_shards):
 
     d2, _, _, d2_log = _build(
         TpuMatcher, True, matcher_batch_lines=64, drain_resolve_depth=2,
-        matcher_prefilter_cand_frac=0.5,
+        matcher_prefilter_cand_frac=0.5, pallas_single_kernel="off",
     )
     d2_results, _ = _run_pipelined(
         d2, lines, now, workers=0, sizer=BigSizer(seed=3)
@@ -318,10 +323,13 @@ def test_depth2_drain_stale_masks_per_chunk():
     drain happens 3 s after encode.  Per-chunk live masks must compose
     with the deferred commits exactly as at depth 1."""
     now = time.time()
+    # two-program path pinned: resolve-ahead + drain-time live masks are
+    # ITS machinery (the single-kernel path commits at submit and takes
+    # the staleness cut there)
     m, _, _, ban_log = _build(
         TpuMatcher, True,
         matcher_batch_lines=64, drain_resolve_depth=2,
-        matcher_prefilter_cand_frac=1.0,
+        matcher_prefilter_cand_frac=1.0, pallas_single_kernel="off",
     )
     # chunk 0: all stale at drain; chunk 1: half and half; chunk 2: fresh
     old = [

@@ -217,7 +217,12 @@ class TestSplitProtocol:
     def test_stale_at_drain_time_is_dropped_and_counted(self, device_windows):
         now = time.time()
         lines = lines_at(now, 20)
-        m, states, banner = make_matcher(device_windows)
+        # pallas_single_kernel=off: drop-at-DRAIN is the two-program/
+        # classic contract (the single-kernel path commits at submit and
+        # takes the cut there — tests/unit/test_fused_single_kernel.py)
+        m, states, banner = make_matcher(
+            device_windows, pallas_single_kernel="off"
+        )
         state = m.pipeline_begin(lines, now)
         m.pipeline_submit(state)
         m.pipeline_collect(state)
