@@ -211,6 +211,7 @@ class BanjaxApp:
                 config_hash_fn=self._config_hash,
                 health=self.health,
                 slo_getter=lambda: self.slo,
+                traffic_fn=self._traffic_snapshot,
             )
             flightrec_mod.install(self.flightrec)
 
@@ -292,6 +293,14 @@ class BanjaxApp:
             supervisor=self._supervisor, slo=self.slo,
             flightrec=self.flightrec,
         )
+
+    def _traffic_snapshot(self):
+        """traffic.json for incident bundles (obs/sketch.py): a forced
+        sketch pull so the bundle shows the flood as of the incident."""
+        sketch = getattr(self._matcher, "traffic_sketch", None)
+        if sketch is None:
+            return {"enabled": False}
+        return sketch.incident_snapshot()
 
     def _config_hash(self) -> str:
         """sha256 of the on-disk config file — ties an incident bundle

@@ -334,6 +334,24 @@ class Config:
     flightrec_min_interval_s: float = 60.0  # capture debounce
     flightrec_keep: int = 16  # newest bundles retained
     flightrec_provenance_records: int = 256  # ledger tail per bundle
+    # --- traffic introspection plane (obs/sketch.py; /traffic/top) ---
+    # device-resident streaming sketches updated in-stream per matcher
+    # chunk: a count-min sketch over client-IP hashes (heavy hitters), a
+    # HyperLogLog register array (distinct-source cardinality) and
+    # per-rule match-pressure accumulators.  Requires
+    # matcher_device_windows (the update keys on the window slot ids the
+    # device already holds); read-only telemetry — sketch-on output is
+    # differentially proven byte-identical to sketch-off.
+    traffic_sketch_enabled: bool = True
+    traffic_sketch_depth: int = 4       # count-min rows (1..8)
+    traffic_sketch_width: int = 8192    # count-min buckets per row
+    traffic_sketch_hll_p: int = 12      # HLL registers = 2^p (~1.6% err)
+    # sampling interval for the compact device->host pull every consumer
+    # (/traffic/top, /metrics, the 29 s line, incident bundles) shares;
+    # the sketch is NEVER pulled per batch
+    traffic_sketch_pull_seconds: float = 5.0
+    traffic_sketch_topk: int = 32       # heavy-hitter heap size
+    traffic_sketch_candidates: int = 8192  # host candidate-IP LRU bound
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -388,6 +406,10 @@ _SCALAR_KEYS = {
     "slo_budget_trip_ratio_max": float,
     "flightrec_dir": str, "flightrec_min_interval_s": float,
     "flightrec_keep": int, "flightrec_provenance_records": int,
+    "traffic_sketch_enabled": bool, "traffic_sketch_depth": int,
+    "traffic_sketch_width": int, "traffic_sketch_hll_p": int,
+    "traffic_sketch_pull_seconds": float, "traffic_sketch_topk": int,
+    "traffic_sketch_candidates": int,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -567,6 +589,32 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
             "config keys slo_sample_seconds/flightrec_min_interval_s: "
             f"expected non-negative, got {cfg.slo_sample_seconds}/"
             f"{cfg.flightrec_min_interval_s}"
+        )
+    if not 1 <= cfg.traffic_sketch_depth <= 8:
+        raise ValueError(
+            "config key traffic_sketch_depth: expected 1..8, got "
+            f"{cfg.traffic_sketch_depth}"
+        )
+    if cfg.traffic_sketch_width < 16:
+        raise ValueError(
+            "config key traffic_sketch_width: expected >= 16, got "
+            f"{cfg.traffic_sketch_width}"
+        )
+    if not 4 <= cfg.traffic_sketch_hll_p <= 16:
+        raise ValueError(
+            "config key traffic_sketch_hll_p: expected 4..16, got "
+            f"{cfg.traffic_sketch_hll_p}"
+        )
+    if cfg.traffic_sketch_pull_seconds < 0:
+        raise ValueError(
+            "config key traffic_sketch_pull_seconds: expected "
+            f"non-negative, got {cfg.traffic_sketch_pull_seconds}"
+        )
+    if cfg.traffic_sketch_topk < 1 or cfg.traffic_sketch_candidates < 1:
+        raise ValueError(
+            "config keys traffic_sketch_topk/traffic_sketch_candidates: "
+            f"expected >= 1, got {cfg.traffic_sketch_topk}/"
+            f"{cfg.traffic_sketch_candidates}"
         )
     if cfg.flightrec_keep < 1 or cfg.flightrec_provenance_records < 1:
         raise ValueError(

@@ -67,7 +67,7 @@ def is_loopback_host(host: str) -> bool:
 
 def admin_auth_ok(config, listen_host: str, authorization: str) -> bool:
     """Gate for the admin surface (/healthz, /metrics, /debug/trace,
-    /decisions/explain, /debug/incidents).
+    /decisions/explain, /debug/incidents, /traffic/top).
 
     Open on a loopback listener (the reference's 127.0.0.1:8081 posture —
     local operators and sidecar scrapers need no secret) or when no
@@ -568,6 +568,53 @@ def build_app(deps: ServerDeps,
             "active_decision": active,
         })
 
+    async def traffic_top_route(request: web.Request) -> web.Response:
+        """Live traffic introspection (obs/sketch.py): top-K heavy
+        hitters with conservative count-min estimates, the HLL
+        distinct-IP estimate and per-rule match pressure, refreshed
+        from the device sketch on its sampling interval (?refresh=1
+        forces a pull for an operator staring at a live flood)."""
+        denied = _admin_denied(request)
+        if denied is not None:
+            return denied
+        matcher = deps.matcher_getter() if deps.matcher_getter else None
+        sketch = getattr(matcher, "traffic_sketch", None)
+        if sketch is None:
+            return web.json_response({
+                "enabled": False,
+                "top": [],
+                "hint": "traffic_sketch_enabled + matcher_device_windows "
+                        "required",
+            })
+        try:
+            k = int(request.query.get("k", "0") or 0)
+        except ValueError:
+            return web.json_response(
+                {"error": "k must be an integer"}, status=400
+            )
+        force = request.query.get("refresh") in ("1", "true")
+        summary = sketch.pull(force=force)
+        top = summary["top"]
+        if k > 0:
+            top = top[:k]
+        age = sketch.pull_age_seconds()
+        return web.json_response({
+            "enabled": True,
+            "k": k or summary["k_max"],
+            "k_max": summary["k_max"],
+            "top": top,
+            "distinct_ips_estimate": summary["distinct_ips_estimate"],
+            "heavy_hitter_share": summary["heavy_hitter_share"],
+            "lines_total": summary["lines_total"],
+            "rule_pressure": summary["rule_pressure"],
+            "sketch": {
+                **summary["sketch"],
+                "pull_age_seconds": (
+                    None if age is None else round(age, 3)
+                ),
+            },
+        })
+
     async def debug_incidents_route(request: web.Request) -> web.Response:
         """Flight-recorder surface: list bundles, fetch a manifest, or
         fetch one bundle file (?name=…&file=…)."""
@@ -610,6 +657,7 @@ def build_app(deps: ServerDeps,
         app.router.add_get("/debug/trace", debug_trace_route)
         app.router.add_get("/decisions/explain", decisions_explain_route)
         app.router.add_get("/debug/incidents", debug_incidents_route)
+        app.router.add_get("/traffic/top", traffic_top_route)
         app.router.add_get("/decision_lists", decision_lists_route)
         app.router.add_get("/rate_limit_states", rate_limit_states_route)
         app.router.add_get("/is_banned", is_banned)
@@ -769,7 +817,8 @@ async def run_http_server(
         log.warning(
             "http listener binds non-loopback %s with no admin_token: the "
             "admin surface (/healthz /metrics /debug/trace "
-            "/decisions/explain /debug/incidents) is open to the network",
+            "/decisions/explain /debug/incidents /traffic/top) is open to "
+            "the network",
             listen_host,
         )
 

@@ -68,6 +68,9 @@ COLD_ROUTES = (
     "/debug/trace",
     "/decisions/explain",
     "/debug/incidents",
+    # traffic introspection (obs/sketch.py): the sketch lives with the
+    # matcher in the primary
+    "/traffic/top",
 )
 
 

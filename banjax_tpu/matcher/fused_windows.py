@@ -152,11 +152,16 @@ class FusedWindowsPipeline:
 
     def __init__(self, prefilter: FusedPrefilter, windows: DeviceWindows,
                  active_table, n_rules: int, single_kernel: bool = False,
-                 scan_interpret: bool = True):
+                 scan_interpret: bool = True, traffic_sketch=None):
         self.pf = prefilter
         self.windows = windows
         self.active_table = jnp.asarray(active_table)
         self.n_rules = n_rules
+        # traffic introspection (obs/sketch.py): every submitted chunk
+        # folds into the device-resident count-min/HLL/rule-pressure
+        # sketches as one more stateless array op — telemetry only, no
+        # interaction with window state or results
+        self._traffic_sketch = traffic_sketch
         self._match_fns = {}
         self._apply_fns = {}
         # single-kernel mode (kernels/fused_match_window.py): submit
@@ -428,6 +433,7 @@ class FusedWindowsPipeline:
                 host_idx_p, live,
             )
             p.slots = np.asarray(slots)
+            self._sketch_update(p)
             return p
         match, K, P = self._match_prog(Bp, L_p)
         sparse_buf, bits_dev = match(
@@ -440,7 +446,7 @@ class FusedWindowsPipeline:
         with self._cv:
             seq = self._next_seq
             self._next_seq += 1
-        return _Pend(
+        p = _Pend(
             seq=seq, sparse_buf=sparse_buf, bits_dev=bits_dev,
             slots=np.asarray(slots),
             ts_s=pad(ts_s).astype(np.int32),
@@ -451,6 +457,21 @@ class FusedWindowsPipeline:
             # dense [B, n_rules] bitmap
             h2d_bytes=combined.nbytes + 4 * 3 * Bp,
         )
+        self._sketch_update(p)
+        return p
+
+    def _sketch_update(self, p: _Pend) -> None:
+        """Fold one submitted chunk's rows into the count-min/HLL
+        sketches (keyed on the slot ids already bound for the device).
+        Unconditional at submit — an overflowed chunk's classic replay
+        does NOT re-fold, so each line counts exactly once on this
+        path."""
+        if self._traffic_sketch is None:
+            return
+        try:
+            self._traffic_sketch.update(p.slots, p.B)
+        except Exception:  # noqa: BLE001 — telemetry must never cost a chunk
+            log.exception("traffic sketch update failed")
 
     def _wait_turn(self, p: _Pend, attr: str) -> None:
         with self._cv:

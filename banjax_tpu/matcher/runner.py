@@ -60,8 +60,16 @@ log = logging.getLogger(__name__)
 
 _MIN_BUCKET = 64
 
+# process-wide warn-once for the drain_resolve_depth/single-kernel no-op
+# (tests construct many matchers; one log line is the useful signal)
+_DEPTH_IGNORED_WARNED = False
+
 
 class TpuMatcher(Matcher):
+    # True when drain_resolve_depth > 1 is configured but the active
+    # single-kernel path makes it a no-op (SingleKernelDepthIgnored)
+    single_kernel_depth_ignored = False
+
     def __init__(
         self,
         config: Config,
@@ -229,6 +237,7 @@ class TpuMatcher(Matcher):
         # bypassed (introspection goes through self.device_windows)
         self.device_windows = None
         self._active_table = None
+        self.traffic_sketch = None
         self._host_row: Dict[str, int] = {}
         if getattr(config, "matcher_device_windows", False):
             from banjax_tpu.matcher.windows import DeviceWindows
@@ -258,6 +267,29 @@ class TpuMatcher(Matcher):
                         continue
                     table[row, idx] = True
             self._active_table = jnp.asarray(table)
+
+            # traffic introspection plane (obs/sketch.py): count-min +
+            # HLL + per-rule pressure folded in-stream per chunk, keyed
+            # on the window slot ids already bound for the device — a
+            # read-only telemetry sibling of the window state (ROADMAP
+            # mega-state item 1 builds its cold admission on the same
+            # structure)
+            if getattr(config, "traffic_sketch_enabled", True):
+                from banjax_tpu.obs.sketch import TrafficSketch
+
+                self.traffic_sketch = TrafficSketch(
+                    [r.rule for _, r in self._entries],
+                    depth=getattr(config, "traffic_sketch_depth", 4),
+                    width=getattr(config, "traffic_sketch_width", 8192),
+                    hll_p=getattr(config, "traffic_sketch_hll_p", 12),
+                    pull_seconds=getattr(
+                        config, "traffic_sketch_pull_seconds", 5.0
+                    ),
+                    topk=getattr(config, "traffic_sketch_topk", 32),
+                    max_candidates=getattr(
+                        config, "traffic_sketch_candidates", 8192
+                    ),
+                )
 
         self._mesh_matcher = None
         if self._mesh_rp:
@@ -395,6 +427,7 @@ class TpuMatcher(Matcher):
                 self._prefilter, self.device_windows, self._active_table,
                 self.compiled.n_rules, single_kernel=single,
                 scan_interpret=scan_interpret,
+                traffic_sketch=self.traffic_sketch,
             )
             log.info(
                 "fused matcher+windows pipeline active (%s)",
@@ -434,10 +467,32 @@ class TpuMatcher(Matcher):
             if comp is not None:
                 comp.degraded(msg)
             return False, scan_interpret
+        # PR 7 silently ignored drain_resolve_depth on this path (the
+        # drain has no program-B dispatch left to overlap): surface the
+        # no-op as a warn-once + health note + SingleKernelDepthIgnored
+        # gauge instead of letting the knob look live
+        depth_note = ""
+        if self._drain_resolve_depth > 1:
+            self.single_kernel_depth_ignored = True
+            depth_note = (
+                f"; drain_resolve_depth={self._drain_resolve_depth} is a "
+                "no-op here (no program-B dispatch to overlap)"
+            )
+            global _DEPTH_IGNORED_WARNED
+            if not _DEPTH_IGNORED_WARNED:
+                _DEPTH_IGNORED_WARNED = True
+                log.warning(
+                    "drain_resolve_depth=%d is configured but the "
+                    "single-kernel fused path commits at submit — the "
+                    "resolve-ahead depth is a no-op (set "
+                    "pallas_single_kernel: off to use it, or drop the key)",
+                    self._drain_resolve_depth,
+                )
         if comp is not None:
             comp.ok(
                 "single-kernel fused path active "
                 + ("(interpret scan)" if scan_interpret else "(compiled scan)")
+                + depth_note
             )
         return True, scan_interpret
 
@@ -1229,6 +1284,14 @@ class TpuMatcher(Matcher):
         uslots = self.device_windows.slots_for_unique_ips(uips)
         if uslots is None:
             return None
+        if self.traffic_sketch is not None:
+            # refresh the sketch's slot→ip-hash table for this batch's
+            # distinct assignments (scatters only CHANGED slots); a
+            # telemetry failure must never cost the batch
+            try:
+                self.traffic_sketch.note_assignments(uips, uslots)
+            except Exception:  # noqa: BLE001 — sketch is passive by contract
+                log.exception("traffic sketch slot-table refresh failed")
         return uslots[uinv]
 
     def _native_gate(self, nb, lines, now, results, use_scratch=True):
@@ -1676,6 +1739,17 @@ class TpuMatcher(Matcher):
         dropped: their bits were masked out of the window apply, so no
         event exists for them and no effect may fire."""
         evmap = {(e.line, e.rule_id): e for e in events}
+        if self.traffic_sketch is not None and events:
+            # per-rule match pressure, counted where every fired window
+            # event already lands (fused commit, overflow fallback and
+            # classic apply all replay through here) — exact even when a
+            # chunk's device bitmap overflowed
+            try:
+                self.traffic_sketch.note_rule_events(
+                    e.rule_id for e in events
+                )
+            except Exception:  # noqa: BLE001 — sketch is passive
+                log.exception("traffic sketch rule-pressure update failed")
         if sparse is not None:
             row_ids = self._sparse_row_sets(len(work), sparse)
             row_iter = sorted(row_ids)
@@ -1742,6 +1816,13 @@ class TpuMatcher(Matcher):
                 # exists to eliminate: count it so the win is measurable
                 if isinstance(bits_c, np.ndarray):
                     self.stats.note_xfer(h2d_bytes=bits_c.nbytes)
+                if self.traffic_sketch is not None:
+                    # fold the chunk into the count-min/HLL sketches (the
+                    # fused paths do this at their device submit instead)
+                    try:
+                        self.traffic_sketch.update(slots, len(work_c))
+                    except Exception:  # noqa: BLE001 — sketch is passive
+                        log.exception("traffic sketch update failed")
                 events = self.device_windows.apply_bitmap(
                     bits_c, slots, ts_s, ts_ns, self._active_table, host_idx
                 )

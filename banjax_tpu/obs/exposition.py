@@ -159,6 +159,21 @@ def render_prometheus(
             for k, frac in enumerate(fracs):
                 w.sample(fam, frac, {"worker": str(k)})
 
+    # traffic introspection: per-rule match-pressure counters from the
+    # device sketch's last compact pull (obs/sketch.py) — only rules
+    # with any recorded pressure emit, so a 1k-rule config doesn't pay
+    # 1k lines per scrape while idle
+    sketch = getattr(matcher, "traffic_sketch", None) if matcher else None
+    if sketch is not None:
+        try:
+            pressure = sketch.pull().get("rule_pressure", ())
+        except Exception:  # noqa: BLE001 — telemetry must not break a scrape
+            pressure = ()
+        if pressure:
+            fam = registry.PROM_FAMILIES["banjax_traffic_rule_pressure"]
+            for row in sorted(pressure, key=lambda r: r["rule"]):
+                w.sample(fam, row["events"], {"rule": row["rule"]})
+
     # decision provenance: per-(source, decision) insert totals from the
     # process ledger (obs/provenance.py) — the attribution counter family
     from banjax_tpu.obs import provenance as provenance_mod

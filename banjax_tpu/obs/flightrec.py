@@ -12,6 +12,9 @@ an incident bundle to ``flightrec_dir``:
                           the span ring (obs/trace.py export_chrome)
         metrics.prom      full Prometheus text snapshot (parseable by
                           obs/exposition.parse_text_format)
+        traffic.json      traffic-sketch snapshot (obs/sketch.py): top-K
+                          heavy hitters, distinct-IP estimate, per-rule
+                          pressure — what the flood looked like
         provenance.json   last N decision-provenance records
         meta.json         reason, detail, timestamps, config hash,
                           health snapshot, SLO burn state
@@ -62,6 +65,7 @@ class FlightRecorder:
         config_hash_fn: Optional[Callable[[], str]] = None,
         health=None,
         slo_getter: Optional[Callable[[], object]] = None,
+        traffic_fn: Optional[Callable[[], Optional[dict]]] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.directory = directory
@@ -72,6 +76,7 @@ class FlightRecorder:
         self._config_hash_fn = config_hash_fn
         self._health = health
         self._slo_getter = slo_getter
+        self._traffic_fn = traffic_fn
         self._clock = clock
         self._lock = threading.Lock()
         self._last_capture = float("-inf")
@@ -115,6 +120,19 @@ class FlightRecorder:
                 files["metrics.prom"] = self._metrics_text_fn()
             except Exception as e:  # noqa: BLE001 — partial bundle beats none
                 files["metrics.prom"] = f"# capture failed: {e}\n"
+        # traffic snapshot (obs/sketch.py): what the flood looked like —
+        # heavy hitters, distinct-source estimate, per-rule pressure —
+        # as of THIS incident (a forced pull, not the last sampling tick)
+        traffic: Optional[dict] = None
+        if self._traffic_fn is not None:
+            try:
+                traffic = self._traffic_fn()
+            except Exception as e:  # noqa: BLE001 — partial bundle beats none
+                traffic = {"enabled": False, "error": str(e)}
+        files["traffic.json"] = json.dumps(
+            traffic if traffic is not None else {"enabled": False},
+            indent=1,
+        )
         files["provenance.json"] = json.dumps(
             {
                 "records": provenance.get_ledger().tail(self.provenance_tail),

@@ -172,6 +172,11 @@ FAMILIES: List[Family] = [
            "one-pull witness: flags + pairs + events in ONE buffer)",
            line_key="SingleKernelD2hBytesPerBatch",
            prom="banjax_single_kernel_d2h_bytes_per_batch"),
+    Family(GAUGE, "1 when drain_resolve_depth > 1 is configured but the "
+           "single-kernel path makes it a no-op (no program-B dispatch "
+           "left to overlap)",
+           line_key="SingleKernelDepthIgnored",
+           prom="banjax_single_kernel_depth_ignored"),
     # ---- breaker / degraded mode ----
     Family(GAUGE, "circuit breaker state (one-hot by state label)",
            line_key="MatcherBreakerState",
@@ -200,6 +205,29 @@ FAMILIES: List[Family] = [
     Family(COUNTER, "incident bundles captured by the flight recorder "
            "(obs/flightrec.py; /debug/incidents)",
            prom="banjax_flightrec_incidents_total"),
+    # ---- traffic introspection plane (obs/sketch.py; /traffic/top) ----
+    Family(COUNTER, "log lines folded into the device traffic sketch "
+           "(count-min + HLL + rule pressure)",
+           line_key="TrafficSketchLines",
+           prom="banjax_traffic_sketch_lines_total"),
+    Family(GAUGE, "estimated distinct client IPs (HyperLogLog registers, "
+           "as of the last sketch pull)",
+           line_key="TrafficDistinctIpsEst",
+           prom="banjax_traffic_distinct_ips_estimate"),
+    Family(GAUGE, "top heavy hitter's estimated share of sketched lines "
+           "(count-min point estimate / lines folded)",
+           line_key="TrafficHeavyHitterShare",
+           prom="banjax_traffic_heavy_hitter_share"),
+    Family(COUNTER, "bytes pulled device->host by periodic sketch "
+           "refreshes (compact pulls, never per batch)",
+           line_key="TrafficSketchPullBytes",
+           prom="banjax_traffic_sketch_pull_bytes_total"),
+    Family(GAUGE, "age of the newest sketch pull (s)",
+           line_key="TrafficSketchPullAgeSeconds",
+           prom="banjax_traffic_sketch_pull_age_seconds"),
+    Family(COUNTER, "fired (line, rule) window events folded into the "
+           "sketch, per rule — which rules absorb the flood",
+           prom="banjax_traffic_rule_pressure", labels=("rule",)),
     # ---- pipeline scheduler ----
     Family(COUNTER, "lines+commands admitted into the pipeline",
            line_key="PipelineAdmittedLines",

@@ -1,0 +1,460 @@
+"""Traffic introspection plane: device-resident streaming sketches.
+
+PR 5/6 made the *engine* observable (spans, /metrics, provenance, SLO
+burn, incident bundles); this module makes the *traffic* observable
+mid-flood, before any ban fires: who the heavy hitters are, how many
+distinct sources are active, and which rules are under pressure.
+
+Three classic streaming structures live as flat device arrays and fold
+every matcher chunk in-stream, as one more stateless array op next to
+the fused match+window dispatch (zero interaction with window state —
+the differential suite proves sketch-on == sketch-off on ban-log bytes,
+result stream and window state):
+
+  * a count–min sketch (Cormode & Muthukrishnan, 2005) over client-IP
+    hashes — [depth * width] int32, conservative point estimates that
+    never undercount, so the host-side top-K heap ranks heavy hitters
+    from periodic compact pulls;
+  * a HyperLogLog register array (Flajolet et al., 2007) — 2^p int32
+    registers for distinct-source cardinality at ~1.04/sqrt(2^p)
+    relative error.
+
+Per-rule match-pressure accumulators (the "which rule is absorbing the
+flood" view) ride the HOST side instead: every fired (line, rule)
+window event already crosses to the host for the Banner replay, on
+every path — fused commit, overflow fallback, classic apply — so
+counting there is exact even for chunks whose device bitmap was
+incomplete (candidate overflow), at O(events) cost the replay already
+pays.
+
+Zero extra per-row h2d traffic: the update keys on the per-row window
+SLOT ids the fused path already uploads, gathered through a
+device-resident slot→ip-hash table that the host refreshes only for
+newly-assigned slots (`note_assignments`, fed from the same unique-IP
+tables the slot manager walks anyway).  In steady state — the slot table
+warm — a chunk's sketch update uploads nothing at all.
+
+Pulls are PERIODIC, never per-batch: `pull()` is throttled by
+`traffic_sketch_pull_seconds` (one compact d2h of ~depth*width*4 +
+2^p*4 + n_rules*4 bytes, traced as a `sketch-pull` span), and every
+consumer — `GET /traffic/top`, the 29 s line, /metrics, flight-recorder
+bundles — reads the cached summary between refreshes.
+
+This is deliberately the read-only half of ROADMAP item 1 (mega-state):
+the cold-admission decision the mega-state PR needs can gate on exactly
+these estimates; building the sketch first as telemetry de-risks it.
+"""
+
+from __future__ import annotations
+
+import functools
+import heapq
+import logging
+import math
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from banjax_tpu.obs import trace
+
+log = logging.getLogger(__name__)
+
+# xor seeds decorrelating the count-min rows (any fixed distinct values
+# work: the row hash is fmix32(ip_hash ^ seed_j));  the golden-ratio
+# constant seeds the independent HLL hash
+_CM_SEEDS = (0x0000_0000, 0x7F4A_7C15, 0x94D0_49BB, 0xDE82_4AD5,
+             0x1B87_3593, 0xC2B2_AE35, 0x27D4_EB2F, 0x1656_67B1)
+_HLL_SEED = 0x9E37_79B9
+
+_MIN_ROW_BUCKET = 64
+_MIN_SLOT_TABLE = 1024
+
+
+def _bucket(n: int, floor: int) -> int:
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _fmix32_np(h: np.ndarray) -> np.ndarray:
+    """murmur3 finalizer, numpy uint32 — the HOST mirror of the device
+    mix below; the two must agree bit-for-bit or point estimates read
+    the wrong buckets."""
+    h = h.astype(np.uint32, copy=True)
+    h ^= h >> np.uint32(16)
+    h *= np.uint32(0x85EB_CA6B)
+    h ^= h >> np.uint32(13)
+    h *= np.uint32(0xC2B2_AE35)
+    h ^= h >> np.uint32(16)
+    return h
+
+
+def _fmix32_jnp(h):
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EB_CA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2_AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def hash_ip(ip: str) -> int:
+    """The 32-bit base hash of one client-IP string (crc32 of the utf-8
+    bytes).  Every derived hash — count-min rows, the HLL register pick
+    — mixes from THIS value, on host and device alike."""
+    return zlib.crc32(ip.encode("utf-8", "surrogatepass")) & 0xFFFF_FFFF
+
+
+def hll_estimate(registers: np.ndarray) -> float:
+    """Standard bias-corrected HyperLogLog estimate with the
+    small-range (linear counting) correction; the large-range 32-bit
+    correction is omitted on purpose — at 2^30+ distinct sources the
+    answer "effectively unbounded" is the operational truth."""
+    m = registers.size
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    raw = alpha * m * m / float(np.sum(np.exp2(-registers.astype(np.float64))))
+    if raw <= 2.5 * m:
+        zeros = int(np.count_nonzero(registers == 0))
+        if zeros:
+            return m * math.log(m / zeros)
+    return raw
+
+
+class TrafficSketch:
+    """Device-resident traffic sketches with a host-side top-K view.
+
+    Thread-safe: `note_assignments` / `update` / `pull` may race from
+    the submit and drain threads; one lock serializes the donated-state
+    device dispatches and the host bookkeeping.  A sketch failure must
+    never cost a log line — callers wrap update hooks, and `pull`
+    degrades to the last cached summary.
+    """
+
+    def __init__(
+        self,
+        rule_names: Sequence[str],
+        *,
+        depth: int = 4,
+        width: int = 8192,
+        hll_p: int = 12,
+        pull_seconds: float = 5.0,
+        topk: int = 32,
+        max_candidates: int = 8192,
+    ):
+        if not 1 <= depth <= len(_CM_SEEDS):
+            raise ValueError(f"sketch depth must be 1..{len(_CM_SEEDS)}")
+        if width < 16:
+            raise ValueError("sketch width must be >= 16")
+        if not 4 <= hll_p <= 16:
+            raise ValueError("hll_p must be 4..16")
+        self.depth = int(depth)
+        self.width = int(width)
+        self.hll_p = int(hll_p)
+        self.m = 1 << self.hll_p
+        self.pull_seconds = max(0.0, float(pull_seconds))
+        self.topk = max(1, int(topk))
+        self.max_candidates = max(self.topk, int(max_candidates))
+        self.rule_names = list(rule_names)
+        self._n_rules = max(1, len(self.rule_names))
+
+        self._lock = threading.Lock()
+        # donated device state: (cm [depth*width], hll [m])
+        self._state = (
+            jnp.zeros((self.depth * self.width,), dtype=jnp.int32),
+            jnp.zeros((self.m,), dtype=jnp.int32),
+        )
+        # per-rule pressure: host-side exact counts of fired (line, rule)
+        # window events (note_rule_events, fed from the Banner replay)
+        self._rule_hits = np.zeros(self._n_rules, dtype=np.int64)
+        # slot → ip-hash table: device copy gathered by the update op
+        # (the per-row hashes are already on device once a slot is warm),
+        # host mirror diffed per batch so only CHANGED slots scatter up
+        self._slot_hash_dev = jnp.zeros((_MIN_SLOT_TABLE,), dtype=jnp.uint32)
+        self._slot_hash_host = np.zeros(_MIN_SLOT_TABLE, dtype=np.uint32)
+        # candidate heavy hitters: LRU of recently-seen distinct IPs and
+        # their base hashes — the enumerable key set a count-min sketch
+        # itself cannot provide.  A true heavy hitter recurs every batch,
+        # so it cannot age out of a bound >> topk.
+        self._candidates: "OrderedDict[str, int]" = OrderedDict()
+        self._update_fns: Dict[tuple, object] = {}
+
+        self.lines_total = 0          # lines folded into the sketch
+        self.update_count = 0
+        self.pull_count = 0
+        self.pull_bytes_total = 0
+        self._last_pull_mono: Optional[float] = None
+        self._summary: Optional[dict] = None
+        self._seeds = jnp.asarray(
+            np.asarray(_CM_SEEDS[: self.depth], dtype=np.uint32)
+        )
+
+    # ---- host bookkeeping (slot table + candidates) ----
+
+    def note_assignments(
+        self, ips: Sequence[str], slots: np.ndarray
+    ) -> None:
+        """Refresh the slot→hash table for one batch's DISTINCT
+        (ip, slot) pairs — the same unique tables the slot manager just
+        walked.  Only slots whose owner changed scatter to the device;
+        a warm table uploads nothing."""
+        n = len(ips)
+        if n == 0:
+            return
+        slots = np.asarray(slots, dtype=np.int64)
+        with self._lock:
+            cand = self._candidates
+            hashes = np.empty(n, dtype=np.uint32)
+            for k, ip in enumerate(ips):
+                h = cand.get(ip)
+                if h is None:
+                    h = hash_ip(ip)
+                cand[ip] = h  # insert or refresh recency
+                cand.move_to_end(ip)
+                hashes[k] = h
+            while len(cand) > self.max_candidates:
+                cand.popitem(last=False)
+
+            need = int(slots.max()) + 1
+            if need > self._slot_hash_host.size:
+                new_size = _bucket(need, _MIN_SLOT_TABLE)
+                grown = np.zeros(new_size, dtype=np.uint32)
+                grown[: self._slot_hash_host.size] = self._slot_hash_host
+                self._slot_hash_host = grown
+                self._slot_hash_dev = jnp.concatenate([
+                    self._slot_hash_dev,
+                    jnp.zeros(
+                        new_size - self._slot_hash_dev.shape[0],
+                        dtype=jnp.uint32,
+                    ),
+                ])
+            changed = self._slot_hash_host[slots] != hashes
+            if changed.any():
+                ch_slots = slots[changed]
+                ch_hash = hashes[changed]
+                self._slot_hash_host[ch_slots] = ch_hash
+                # pow2-bucketed scatter (padded entries index out of
+                # range and drop) so the jit cache stays bounded
+                kk = _bucket(len(ch_slots), 64)
+                idx = np.full(kk, self._slot_hash_host.size, dtype=np.int32)
+                idx[: len(ch_slots)] = ch_slots
+                val = np.zeros(kk, dtype=np.uint32)
+                val[: len(ch_hash)] = ch_hash
+                self._slot_hash_dev = _scatter_hashes(
+                    self._slot_hash_dev, jnp.asarray(idx), jnp.asarray(val)
+                )
+
+    # ---- the per-chunk device update ----
+
+    def _update_fn(self, Bp: int, cap: int):
+        key = (Bp, cap)
+        fn = self._update_fns.get(key)
+        if fn is not None:
+            return fn
+        depth, width, p = self.depth, self.width, self.hll_p
+        seeds = self._seeds
+        low_bits = 32 - p
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def update(state, slot_hash, slots, n_real):
+            cm, hll = state
+            h = slot_hash[slots]                         # [Bp] uint32
+            real = jax.lax.iota(jnp.int32, Bp) < n_real
+            inc = real.astype(jnp.int32)
+            # count-min: one bucket increment per row per line (scatter-
+            # add accumulates duplicate indices — repeated IPs in a batch
+            # land their full count)
+            hx = h[None, :] ^ seeds[:, None]             # [depth, Bp]
+            col = (_fmix32_jnp(hx) % jnp.uint32(width)).astype(jnp.int32)
+            flat = col + (
+                jnp.arange(depth, dtype=jnp.int32)[:, None] * width
+            )
+            cm = cm.at[flat.reshape(-1)].add(
+                jnp.broadcast_to(inc[None, :], (depth, Bp)).reshape(-1)
+            )
+            # HLL: register = top p bits of an independent mix, rho =
+            # leading zeros of the remaining bits + 1 (bit-smear +
+            # popcount gives the MSB position exactly — no float log)
+            g = _fmix32_jnp(h ^ jnp.uint32(_HLL_SEED))
+            reg = (g >> jnp.uint32(low_bits)).astype(jnp.int32)
+            w = g & jnp.uint32((1 << low_bits) - 1)
+            fill = w
+            for s in (1, 2, 4, 8, 16):
+                fill = fill | (fill >> jnp.uint32(s))
+            msb_cnt = jax.lax.population_count(fill).astype(jnp.int32)
+            rho = low_bits - msb_cnt + 1
+            hll = hll.at[reg].max(jnp.where(real, rho, 0))
+            return cm, hll
+
+        self._update_fns[key] = update
+        return update
+
+    def update(self, slots, n_real: int) -> None:
+        """Fold one chunk's rows into the count-min and HLL sketches:
+        `slots` per row (rows beyond `n_real` are masked; the row bucket
+        pads to a power of two so the jit cache stays bounded).  One
+        stateless donated-array dispatch; nothing is read back."""
+        slots_np = np.asarray(slots, dtype=np.int32)
+        Bp = _bucket(max(len(slots_np), 1), _MIN_ROW_BUCKET)
+        if len(slots_np) != Bp:
+            slots_np = np.concatenate(
+                [slots_np, np.zeros(Bp - len(slots_np), dtype=np.int32)]
+            )
+        n_real = min(int(n_real), Bp)
+        with self._lock:
+            cap = int(self._slot_hash_dev.shape[0])
+            fn = self._update_fn(Bp, cap)
+            self._state = fn(
+                self._state, self._slot_hash_dev, jnp.asarray(slots_np),
+                jnp.int32(n_real),
+            )
+            self.lines_total += n_real
+            self.update_count += 1
+
+    def note_rule_events(self, rule_ids) -> None:
+        """Fold fired (line, rule) window events into the per-rule
+        pressure accumulators — called from the Banner replay with the
+        event list every path already decodes, so pressure is EXACT even
+        for chunks whose device bitmap overflowed."""
+        ids = np.fromiter(
+            (int(r) for r in rule_ids), dtype=np.int64
+        )
+        if not ids.size:
+            return
+        counts = np.bincount(
+            ids[(ids >= 0) & (ids < self._n_rules)],
+            minlength=self._n_rules,
+        )
+        with self._lock:
+            self._rule_hits += counts
+
+    # ---- the periodic compact pull ----
+
+    def pull(self, force: bool = False) -> dict:
+        """Refresh (throttled by `pull_seconds`) and return the host
+        summary: top-K heavy hitters with conservative count-min
+        estimates, the HLL distinct-IP estimate, per-rule pressure, and
+        pull bookkeeping.  Between refreshes every consumer shares the
+        cached summary — the sketch is pulled on a sampling interval,
+        never per batch."""
+        with self._lock:
+            now_m = time.monotonic()
+            if (
+                not force
+                and self._summary is not None
+                and self._last_pull_mono is not None
+                and now_m - self._last_pull_mono < self.pull_seconds
+            ):
+                return self._summary
+            # a pull belongs to no admission batch: it gets its own
+            # trace id (like shed instants), so the Perfetto view shows
+            # WHEN the compact d2h ran relative to the batch spans
+            sp = trace.begin(
+                "sketch-pull", trace.new_trace(),
+                args={"forced": bool(force)},
+            )
+            try:
+                cm = np.asarray(self._state[0]).reshape(
+                    self.depth, self.width
+                )
+                hll = np.asarray(self._state[1])
+            finally:
+                trace.end(sp)
+            rule_hits = self._rule_hits  # host-side, no pull needed
+            self.pull_bytes_total += cm.nbytes + hll.nbytes
+            self.pull_count += 1
+            self._last_pull_mono = time.monotonic()
+
+            top: List[dict] = []
+            if self._candidates:
+                ips = list(self._candidates)
+                base = np.fromiter(
+                    self._candidates.values(), dtype=np.uint32, count=len(ips)
+                )
+                est = None
+                for j in range(self.depth):
+                    col = _fmix32_np(base ^ np.uint32(_CM_SEEDS[j])) \
+                        % np.uint32(self.width)
+                    vals = cm[j, col.astype(np.int64)]
+                    est = vals if est is None else np.minimum(est, vals)
+                for k in heapq.nlargest(
+                    self.topk, range(len(ips)), key=lambda i: int(est[i])
+                ):
+                    if est[k] <= 0:
+                        break
+                    top.append({"ip": ips[k], "est_count": int(est[k])})
+
+            distinct = hll_estimate(hll)
+            lines = self.lines_total
+            share = (
+                round(top[0]["est_count"] / lines, 4)
+                if top and lines else 0.0
+            )
+            pressure = [
+                {"rule": name, "index": i, "events": int(rule_hits[i])}
+                for i, name in enumerate(self.rule_names)
+                if i < rule_hits.size and rule_hits[i] > 0
+            ]
+            pressure.sort(key=lambda r: -r["events"])
+            self._summary = {
+                "top": top,
+                "k_max": self.topk,
+                "distinct_ips_estimate": round(distinct, 1),
+                "heavy_hitter_share": share,
+                "lines_total": lines,
+                "rule_pressure": pressure,
+                "sketch": {
+                    "depth": self.depth,
+                    "width": self.width,
+                    "hll_registers": self.m,
+                    "candidates": len(self._candidates),
+                    "pull_count": self.pull_count,
+                    "pull_bytes_total": self.pull_bytes_total,
+                },
+            }
+            return self._summary
+
+    def pull_age_seconds(self) -> Optional[float]:
+        with self._lock:
+            if self._last_pull_mono is None:
+                return None
+            return time.monotonic() - self._last_pull_mono
+
+    def estimate_ip(self, ip: str) -> int:
+        """Point estimate for one IP from the LAST pulled count-min
+        state (tests; /traffic debugging).  Conservative: >= the true
+        count folded in before that pull."""
+        summary = self.pull()
+        del summary
+        with self._lock:
+            cm = np.asarray(self._state[0]).reshape(self.depth, self.width)
+        base = np.uint32(hash_ip(ip))
+        est = None
+        for j in range(self.depth):
+            col = int(
+                _fmix32_np(np.asarray([base ^ np.uint32(_CM_SEEDS[j])],
+                                      dtype=np.uint32))[0]
+            ) % self.width
+            v = int(cm[j, col])
+            est = v if est is None else min(est, v)
+        return int(est or 0)
+
+    def incident_snapshot(self) -> dict:
+        """The flight-recorder view (`traffic.json`): a FORCED pull so
+        the bundle shows the flood as of the incident, not the last
+        sampling tick."""
+        out = dict(self.pull(force=True))
+        out["enabled"] = True
+        return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_hashes(table, idx, val):
+    return table.at[idx].set(val, mode="drop")

@@ -173,6 +173,33 @@ class MatcherStats:
                     out["SingleKernelD2hBytesPerBatch"] = round(
                         fw.sk_d2h_bytes_total / max(1, fw.sk_chunks), 1
                     )
+                    # drain_resolve_depth configured but a no-op on this
+                    # path (PR 7 silent-ignore made observable)
+                    out["SingleKernelDepthIgnored"] = bool(
+                        getattr(matcher, "single_kernel_depth_ignored",
+                                False)
+                    )
+            # traffic introspection plane (obs/sketch.py): the sampled
+            # summary — pull() self-throttles to its sampling interval,
+            # so line snapshots and scrapes share one compact d2h
+            ts = getattr(matcher, "traffic_sketch", None)
+            if ts is not None:
+                try:
+                    s = ts.pull()
+                    out["TrafficSketchLines"] = ts.lines_total
+                    out["TrafficDistinctIpsEst"] = s[
+                        "distinct_ips_estimate"
+                    ]
+                    out["TrafficHeavyHitterShare"] = s[
+                        "heavy_hitter_share"
+                    ]
+                    out["TrafficSketchPullBytes"] = ts.pull_bytes_total
+                    age = ts.pull_age_seconds()
+                    out["TrafficSketchPullAgeSeconds"] = (
+                        None if age is None else round(age, 3)
+                    )
+                except Exception:  # noqa: BLE001 — telemetry must not break metrics
+                    pass
             # circuit breaker (resilience/breaker.py): the one place all
             # the ad-hoc fallback counters roll up for operators —
             # nonzero MatcherCpuFallbackBatches = batches served in

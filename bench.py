@@ -73,6 +73,14 @@ off → on → off protocol for the decision provenance ledger
 records, banked into BENCH_provenance_overhead.json.  The acceptance
 gate (ISSUE 6): the ledger-on row must sit inside the off-run noise
 band on the --pipeline-shaped feed.
+
+Sketch-overhead mode: `bench.py --sketch-overhead` — the same
+off → on → off protocol for the device traffic sketch (obs/sketch.py:
+count-min heavy hitters + HLL cardinality + rule pressure), on the
+ban-storm IP rotation so the sketch is actually populated (the banked
+on-row carries sketch_lines/top1 as the witness), banked into
+BENCH_sketch_overhead.json.  Acceptance gate (ISSUE 8): the sketch-on
+row inside the off-run noise band.
 """
 
 from __future__ import annotations
@@ -1081,6 +1089,153 @@ def _provenance_overhead_mode() -> None:
     print(json.dumps(book))
 
 
+SKETCH_OVERHEAD_PATH = os.path.join(_DIR, "BENCH_sketch_overhead.json")
+
+
+def _sketch_overhead_mode() -> None:
+    """`bench.py --sketch-overhead`: A/B the pipelined stream with the
+    device traffic sketch (obs/sketch.py) disabled vs enabled, same
+    off → on → off bracketing protocol as --provenance-overhead, on the
+    SAME ban-storm shape (rotating IP pool, concentrated single-rule
+    attack) so the sketch actually works: heavy hitters recur, slots
+    churn the hash table, and rule pressure accumulates.  The banked
+    row carries a populated-sketch witness (`sketch_lines`, `top1`) so
+    an accidentally-idle sketch can't bank a vacuous "no overhead"."""
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    import yaml as _yaml
+
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from banjax_tpu.obs import trace as trace_mod
+    from banjax_tpu.pipeline import PipelineScheduler
+    from tests.mock_banner import MockBanner
+
+    trace_mod.configure(enabled=False)  # isolate the sketch's cost
+    backend = jax.devices()[0].platform
+    n_rules = int(os.environ.get("BENCH_STREAM_RULES", str(N_RULES)))
+    total = int(os.environ.get(
+        "BENCH_STREAM_LINES", "131072" if backend == "tpu" else "32768"
+    ))
+    feed_chunk = int(os.environ.get("BENCH_STREAM_CHUNK", "64"))
+    budget_ms = float(os.environ.get("BENCH_STREAM_BUDGET_MS", "180"))
+    n_ips = int(os.environ.get("BENCH_PROV_IPS", "256"))
+    hits_per_interval = int(os.environ.get("BENCH_PROV_HITS", "10"))
+    attack_rate = float(os.environ.get("BENCH_PROV_ATTACK", "0.05"))
+    iters = int(os.environ.get("BENCH_TRACE_ITERS", "3"))
+
+    patterns = generate_rules(n_rules)
+    rules_yaml = _yaml.safe_dump({
+        "regexes_with_rates": [
+            {"rule": f"crs{i}", "regex": p, "interval": 60,
+             "hits_per_interval": hits_per_interval,
+             "decision": "nginx_block"}
+            for i, p in enumerate(patterns)
+        ]
+    })
+    now = time.time()
+    rng = random.Random(43)
+    benign = generate_lines(total, patterns, seed=43, attack_rate=0.0)
+    attack_rest = synthesize_match(patterns[0], rng)
+    rests = [
+        attack_rest if rng.random() < attack_rate else benign[i]
+        for i in range(total)
+    ]
+    lines = [
+        f"{now:.6f} 10.9.{(i % n_ips) >> 8}.{(i % n_ips) & 0xFF} {r}"
+        for i, r in enumerate(rests)
+    ]
+    chunks = [lines[i : i + feed_chunk] for i in range(0, total, feed_chunk)]
+
+    def run_mode(enabled: bool) -> dict:
+        cfg = config_from_yaml_text(rules_yaml)
+        # the sketch rides the device-windows fused path (its update keys
+        # on the window slot ids) — both arms run that path
+        cfg.matcher_device_windows = True
+        cfg.traffic_sketch_enabled = enabled
+        matcher = TpuMatcher(
+            cfg, MockBanner(), StaticDecisionLists(cfg),
+            RegexRateLimitStates()
+        )
+        sched = PipelineScheduler(
+            lambda: matcher, latency_budget_ms=budget_ms,
+            buffer_lines=max(131072, total), now_fn=lambda: now,
+        )
+        sched.start()
+        for c in chunks:  # warm pass: compiles + sizer settle
+            sched.submit(c)
+        assert sched.flush(600), "sketch warm pass did not drain"
+        best = 0.0
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            for c in chunks:
+                sched.submit(c)
+            assert sched.flush(600), "sketch pass did not drain"
+            best = max(best, total / (time.perf_counter() - t0))
+        row = {
+            "sketch_enabled": enabled,
+            "value": round(best, 1),
+            "unit": "lines/sec",
+            "backend": backend,
+            "n_rules": n_rules,
+            "n_lines": total,
+            "n_distinct_ips": n_ips,
+            "hits_per_interval": hits_per_interval,
+            "feed_chunk_lines": feed_chunk,
+            "iters_best_of": iters,
+        }
+        if enabled:
+            # the populated-sketch witness: lines actually folded, and a
+            # ranked heavy hitter with a conservative estimate
+            summary = matcher.traffic_sketch.pull(force=True)
+            row["sketch_lines"] = matcher.traffic_sketch.lines_total
+            row["top1"] = summary["top"][0] if summary["top"] else None
+            row["distinct_ips_estimate"] = summary["distinct_ips_estimate"]
+            row["rule_pressure_events"] = sum(
+                r["events"] for r in summary["rule_pressure"]
+            )
+        sched.stop()
+        matcher.close()
+        return row
+
+    # off → on → off bracketing, exactly like --provenance-overhead: the
+    # second off run controls for run-order effects (compile caches,
+    # sizer settle) that can dwarf the effect being measured
+    off_a = run_mode(False)
+    on = run_mode(True)
+    off_b = run_mode(False)
+    off = max(off_a, off_b, key=lambda r: r["value"])
+    noise_band_pct = round(
+        abs(off_a["value"] - off_b["value"])
+        / max(off_a["value"], off_b["value"]) * 100.0, 2
+    )
+    overhead_pct = round(
+        (off["value"] - on["value"]) / off["value"] * 100.0, 2
+    )
+    book = {
+        "metric": "pipelined lines/sec, traffic sketch off vs on",
+        "off": off,
+        "on": on,
+        "off_runs": [off_a["value"], off_b["value"]],
+        "on_vs_off_overhead_pct": overhead_pct,
+        # the off↔off spread IS the noise band; the acceptance gate is
+        # on_within_off_noise_band (ISSUE 8)
+        "off_run_noise_band_pct": noise_band_pct,
+        "on_within_off_noise_band": bool(
+            overhead_pct <= max(noise_band_pct, 1.0)
+        ),
+    }
+    tmp = SKETCH_OVERHEAD_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(book, f, indent=1)
+    os.replace(tmp, SKETCH_OVERHEAD_PATH)
+    print(json.dumps(book))
+
+
 def _host_parallel_mode() -> None:
     """`bench.py --host-parallel`: A/B the two host-path optimizations.
 
@@ -1775,6 +1930,9 @@ def main() -> None:
         return
     if "--provenance-overhead" in sys.argv:
         _provenance_overhead_mode()
+        return
+    if "--sketch-overhead" in sys.argv:
+        _sketch_overhead_mode()
         return
     if "--host-parallel" in sys.argv:
         _host_parallel_mode()

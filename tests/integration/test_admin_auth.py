@@ -34,7 +34,8 @@ regexes_with_rates:
 
 TOKEN = "sekrit-scraper-token"
 ADMIN_ROUTES = ("/healthz", "/metrics", "/debug/trace",
-                "/decisions/explain?ip=9.9.9.9", "/debug/incidents")
+                "/decisions/explain?ip=9.9.9.9", "/debug/incidents",
+                "/traffic/top")
 N_ADMIN = len(ADMIN_ROUTES)
 
 
@@ -240,7 +241,7 @@ def test_new_admin_routes_are_worker_proxied():
     from banjax_tpu.httpapi.workers import COLD_ROUTES, install_proxy_routes
 
     for route in ("/decisions/explain", "/debug/incidents",
-                  "/metrics", "/debug/trace", "/healthz"):
+                  "/metrics", "/debug/trace", "/healthz", "/traffic/top"):
         assert route in COLD_ROUTES, route
 
     app = web.Application()
@@ -249,6 +250,7 @@ def test_new_admin_routes_are_worker_proxied():
                   if r.resource is not None}
     assert "/decisions/explain" in registered
     assert "/debug/incidents" in registered
+    assert "/traffic/top" in registered
 
 
 def test_worker_layout_proxies_new_routes_behind_auth():
@@ -282,7 +284,7 @@ def test_worker_layout_proxies_new_routes_behind_auth():
             try:
                 out = []
                 for path in ("/decisions/explain?ip=9.9.9.9",
-                             "/debug/incidents"):
+                             "/debug/incidents", "/traffic/top"):
                     r = await client.get(path)
                     out.append(r.status)
                     r = await client.get(
@@ -301,6 +303,10 @@ def test_worker_layout_proxies_new_routes_behind_auth():
     assert out[2] == 401                       # incidents: gated via proxy
     assert out[3][0] == 200
     assert out[3][1]["incidents"] == []
+    assert out[4] == 401                       # traffic: gated via proxy
+    assert out[5][0] == 200
+    # no matcher wired into these deps: the route degrades honestly
+    assert out[5][1]["enabled"] is False
 
 
 def test_decisions_explain_route_payload():
@@ -339,3 +345,80 @@ def test_decisions_explain_route_payload():
         assert payload["active_decision"]["from_baskerville"] is True
     finally:
         provenance.configure(enabled=True)
+
+
+def test_traffic_top_route_payload():
+    """GET /traffic/top with a real device-windows matcher wired in:
+    top-K heavy hitters with estimated counts, the HLL cardinality,
+    rule pressure, and the ?k= bound (ISSUE 8 acceptance surface)."""
+    import time
+
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.matcher.runner import TpuMatcher
+
+    cfg = config_from_yaml_text(RULES_YAML)
+    cfg.matcher_device_windows = True
+    matcher = TpuMatcher(
+        cfg, MockBanner(), StaticDecisionLists(cfg), RegexRateLimitStates()
+    )
+    now = time.time()
+    lines = [
+        f"{now:.6f} 5.5.5.5 GET h.com GET /flood{i} HTTP/1.1 ua -"
+        if i % 2 else
+        f"{now:.6f} 10.1.{i % 5}.{i % 30} GET h.com GET /ok HTTP/1.1 ua -"
+        for i in range(200)
+    ]
+    matcher.consume_lines(lines, now)
+
+    deps = _deps(cfg)
+    deps.matcher_getter = lambda: matcher
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        app = server_mod.build_app(deps)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            full = await client.get("/traffic/top",
+                                    params={"refresh": "1"})
+            k1 = await client.get("/traffic/top", params={"k": "1"})
+            bad = await client.get("/traffic/top", params={"k": "zzz"})
+            return (full.status, await full.json(),
+                    k1.status, await k1.json(), bad.status)
+        finally:
+            await client.close()
+
+    status, payload, k_status, k_payload, bad_status = asyncio.run(go())
+    assert status == 200 and k_status == 200
+    assert bad_status == 400
+    assert payload["enabled"] is True
+    assert payload["lines_total"] == 200
+    assert payload["top"][0]["ip"] == "5.5.5.5"
+    assert payload["top"][0]["est_count"] >= 100
+    assert payload["distinct_ips_estimate"] > 0
+    assert payload["rule_pressure"][0]["rule"] == "r"
+    assert payload["sketch"]["pull_age_seconds"] is not None
+    assert len(k_payload["top"]) == 1
+    assert k_payload["k"] == 1
+
+
+def test_traffic_top_without_sketch_reports_disabled():
+    cfg = config_from_yaml_text(RULES_YAML)
+    deps = _deps(cfg)  # no matcher_getter wired at all
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        app = server_mod.build_app(deps)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/traffic/top")
+            return r.status, await r.json()
+        finally:
+            await client.close()
+
+    status, payload = asyncio.run(go())
+    assert status == 200
+    assert payload["enabled"] is False and payload["top"] == []

@@ -332,3 +332,63 @@ def test_budget_trips_on_the_29s_line(loaded_system):
     line = _full_line(*loaded_system)
     assert "MatcherBudgetTrips" in line
     assert registry.is_declared_line_key("MatcherBudgetTrips")
+
+
+def test_traffic_families_render_and_declare(loaded_system):
+    """The ISSUE 8 families: scalar banjax_traffic_* gauges/counters
+    ride the line-key map, the labeled banjax_traffic_rule_pressure
+    comes from the sketch's pulled summary, and everything parses
+    strictly and is registry-declared."""
+    m, sched, health, sup = loaded_system
+    assert m.traffic_sketch is not None
+    m.traffic_sketch.pull(force=True)  # a fresh summary for the render
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False), RegexRateLimitStates(),
+        FailedChallengeRateLimitStates(), matcher=m, pipeline=sched,
+        health=health, supervisor=sup,
+    )
+    fams = parse_text_format(text)
+    undeclared = [f for f in fams if f not in registry.PROM_FAMILIES]
+    assert not undeclared, undeclared
+    scalars = {
+        s[0]: s[2] for ent in fams.values() for s in ent["samples"]
+        if not s[1]
+    }
+    assert scalars["banjax_traffic_sketch_lines_total"] >= 8
+    assert scalars["banjax_traffic_distinct_ips_estimate"] > 0
+    assert scalars["banjax_traffic_sketch_pull_bytes_total"] > 0
+    assert "banjax_traffic_sketch_pull_age_seconds" in fams
+    # the fixture's rule ("GET .*") fires on every line: pressure renders
+    pressure = {
+        s[1]["rule"]: s[2]
+        for s in fams["banjax_traffic_rule_pressure"]["samples"]
+    }
+    assert pressure.get("r", 0) > 0
+    # ... and the line keys are declared too
+    line = _full_line(m, sched, health, sup)
+    for key in ("TrafficSketchLines", "TrafficDistinctIpsEst",
+                "TrafficHeavyHitterShare", "TrafficSketchPullBytes",
+                "TrafficSketchPullAgeSeconds"):
+        assert key in line, key
+        assert registry.is_declared_line_key(key), key
+
+
+def test_single_kernel_depth_ignored_on_line_and_metrics(loaded_system):
+    """The PR 7 silent-ignore satellite: drain_resolve_depth configured
+    (default 2) + single-kernel active => the gauge flags the no-op on
+    both surfaces."""
+    m, sched, health, sup = loaded_system
+    if not (m._fw_pipeline is not None and m._fw_pipeline.single_kernel):
+        pytest.skip("single-kernel path unavailable on this backend")
+    line = _full_line(m, sched, health, sup)
+    assert line["SingleKernelDepthIgnored"] is True
+    assert registry.is_declared_line_key("SingleKernelDepthIgnored")
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False), RegexRateLimitStates(),
+        FailedChallengeRateLimitStates(), matcher=m,
+    )
+    fams = parse_text_format(text)
+    (v,) = [
+        s[2] for s in fams["banjax_single_kernel_depth_ignored"]["samples"]
+    ]
+    assert v == 1

@@ -49,8 +49,12 @@ def test_bundle_layout_and_contents(tmp_path):
     assert name is not None and name.startswith("incident-")
     bundle = tmp_path / "incidents" / name
     assert sorted(os.listdir(bundle)) == [
-        "meta.json", "metrics.prom", "provenance.json", "trace.json"
+        "meta.json", "metrics.prom", "provenance.json", "trace.json",
+        "traffic.json",
     ]
+    # no traffic_fn installed: the section says so instead of vanishing
+    traffic_doc = json.loads((bundle / "traffic.json").read_text())
+    assert traffic_doc == {"enabled": False}
     trace_doc = json.loads((bundle / "trace.json").read_text())
     assert any(e.get("ph") == "X" for e in trace_doc["traceEvents"])
     prov_doc = json.loads((bundle / "provenance.json").read_text())
@@ -126,3 +130,41 @@ def test_module_hook_noop_without_recorder(tmp_path):
     flightrec.install(rec)
     assert flightrec.notify("breaker-trip") is not None
     assert flightrec.installed() is rec
+
+
+def test_bundle_traffic_section_from_sketch(tmp_path):
+    """A recorder wired with a traffic_fn (cli passes the matcher's
+    sketch snapshot) lands the flood view in traffic.json — heavy
+    hitters, cardinality and rule pressure as of the incident."""
+    import numpy as np
+
+    from banjax_tpu.obs.sketch import TrafficSketch
+
+    sk = TrafficSketch(["r0"], width=1024, pull_seconds=3600.0)
+    sk.note_assignments(["6.6.6.6"], np.asarray([0]))
+    sk.update(np.zeros(32, dtype=np.int32), 32)
+    sk.note_rule_events([0, 0, 0])
+    rec = _recorder(tmp_path, traffic_fn=sk.incident_snapshot)
+    name = rec.notify("shed-burst", "flood")
+    assert name is not None
+    doc = json.loads(
+        (tmp_path / "incidents" / name / "traffic.json").read_text()
+    )
+    assert doc["enabled"] is True
+    assert doc["top"][0]["ip"] == "6.6.6.6"
+    assert doc["top"][0]["est_count"] >= 32
+    assert doc["rule_pressure"] == [
+        {"rule": "r0", "index": 0, "events": 3}
+    ]
+    # the incident pull is FORCED: fresh even under a long interval
+    assert doc["lines_total"] == 32
+
+
+def test_bundle_traffic_section_survives_a_failing_fn(tmp_path):
+    rec = _recorder(tmp_path, traffic_fn=lambda: 1 / 0)
+    name = rec.notify("breaker-trip")
+    assert name is not None
+    doc = json.loads(
+        (tmp_path / "incidents" / name / "traffic.json").read_text()
+    )
+    assert doc["enabled"] is False and "error" in doc
