@@ -101,8 +101,9 @@ class BanjaxApp:
         # read the aggregate.  Per-app (not global) so in-process tests
         # don't cross-contaminate.
         self.health = HealthRegistry()
-        if getattr(config, "failpoints", ""):
-            failpoints.arm_from_spec(config.failpoints)
+        self._failpoints_spec = getattr(config, "failpoints", "")
+        if self._failpoints_spec:
+            failpoints.arm_from_spec(self._failpoints_spec)
 
         # pipeline span tracing (obs/trace.py): off by default — the
         # disabled tracer's no-op fast path keeps the hot path at ≤1%
@@ -278,6 +279,15 @@ class BanjaxApp:
         self.static_lists.update_from_config(new_config)
         self.dynamic_lists.clear()
         self.protected_paths.update_from_config(new_config)
+        # re-apply the fault-injection spec only when it CHANGED: a
+        # reload for unrelated keys must not clobber points armed at
+        # runtime via /debug/failpoints
+        new_spec = getattr(new_config, "failpoints", "")
+        if new_spec != self._failpoints_spec:
+            failpoints.disarm()
+            if new_spec:
+                failpoints.arm_from_spec(new_spec)
+            self._failpoints_spec = new_spec
         if self._supervisor is not None:
             self._supervisor.broadcast_reload()
 

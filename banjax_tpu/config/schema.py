@@ -206,8 +206,17 @@ class Config:
     breaker_window_size: int = 0
     # deterministic fault injection (resilience/failpoints.py): same spec
     # syntax as the BANJAX_FAILPOINTS env var, e.g.
-    # "matcher.device=error:5;kafka.read=error". Empty = nothing armed.
+    # "matcher.device=error:5;kafka.read=error" (an optional "@p" suffix
+    # fires probabilistically). Empty = nothing armed. Re-applied on
+    # SIGHUP when the spec changed, so fault drills need no restart.
     failpoints: str = ""
+    # runtime fault-injection admin surface: GET/POST /debug/failpoints
+    # lists/arms/disarms failpoints (admin_token-gated off-loopback like
+    # the rest of the admin surface; the chaos soak and operators drive
+    # failpoints through it without env restarts). false removes the
+    # routes' function entirely — defense in depth for deployments that
+    # never want runtime fault injection reachable.
+    failpoints_admin_enabled: bool = True
     # --- streaming pipeline scheduler (banjax_tpu/pipeline/) ---
     # Overlapped tailer→device→effector batching with adaptive sizing and
     # backpressure; false = the reference-shaped synchronous per-batch
@@ -389,6 +398,7 @@ _SCALAR_KEYS = {
     "breaker_failure_threshold": int, "breaker_recovery_seconds": float,
     "breaker_window_size": int,
     "matcher_latency_budget_ms": float, "failpoints": str,
+    "failpoints_admin_enabled": bool,
     "pipeline_enabled": bool, "pipeline_ring_size": int,
     "pipeline_latency_budget_ms": float, "pipeline_buffer_lines": int,
     "pipeline_max_block_ms": float, "matcher_probe_seconds": float,

@@ -615,6 +615,90 @@ def build_app(deps: ServerDeps,
             },
         })
 
+    async def debug_failpoints_route(request: web.Request) -> web.Response:
+        """Runtime fault-injection admin (resilience/failpoints.py):
+        GET lists the instrumented sites and every armed point (mode,
+        remaining count, fired count, probability); POST arms/disarms —
+        the chaos soak's and operators' no-restart failpoint driver.
+
+        POST body (JSON), any combination, applied in this order:
+            {"disarm_all": true}
+            {"disarm": ["pipeline.submit", ...]}
+            {"arm": [{"name": "pipeline.submit", "mode": "error",
+                      "count": 3, "probability": 0.5, "delay_s": 0.0}]}
+            {"spec": "matcher.device=error:3@0.5;kafka.read"}
+        Responds with the resulting armed list."""
+        denied = _admin_denied(request)
+        if denied is not None:
+            return denied
+        from banjax_tpu.resilience import failpoints
+
+        if not getattr(deps.config_holder.get(),
+                       "failpoints_admin_enabled", True):
+            return web.json_response(
+                {"error": "failpoints_admin_enabled is false"}, status=403
+            )
+        if request.method == "POST":
+            try:
+                body = await request.json()
+            except Exception:  # noqa: BLE001 — client error, not ours
+                return web.json_response(
+                    {"error": "body must be JSON"}, status=400
+                )
+            if not isinstance(body, dict):
+                return web.json_response(
+                    {"error": "body must be a JSON object"}, status=400
+                )
+            if body.get("disarm_all"):
+                failpoints.disarm()
+            for name in body.get("disarm") or []:
+                failpoints.disarm(str(name))
+            arms = body.get("arm") or []
+            if not isinstance(arms, list):
+                return web.json_response(
+                    {"error": "arm must be a list"}, status=400
+                )
+            for ent in arms:
+                if not isinstance(ent, dict) or not ent.get("name"):
+                    return web.json_response(
+                        {"error": "each arm entry needs a name"},
+                        status=400,
+                    )
+                mode = ent.get("mode", "error")
+                if mode not in failpoints.MODES:
+                    return web.json_response(
+                        {"error": f"unknown mode {mode!r}"}, status=400
+                    )
+                count = ent.get("count")
+                if count is not None:
+                    try:
+                        count = int(count)
+                    except (TypeError, ValueError):
+                        return web.json_response(
+                            {"error": "count must be an integer"},
+                            status=400,
+                        )
+                try:
+                    probability = float(ent.get("probability", 1.0))
+                    delay_s = float(ent.get("delay_s", 0.0))
+                except (TypeError, ValueError):
+                    return web.json_response(
+                        {"error": "probability/delay_s must be numbers"},
+                        status=400,
+                    )
+                failpoints.arm(
+                    str(ent["name"]), mode=mode, count=count,
+                    delay_s=delay_s, probability=probability,
+                    seed=ent.get("seed"),
+                )
+            if isinstance(body.get("spec"), str):
+                failpoints.arm_from_spec(body["spec"])
+        return web.json_response({
+            "enabled": True,
+            "sites": list(failpoints.KNOWN_SITES),
+            "armed": failpoints.snapshot(),
+        })
+
     async def debug_incidents_route(request: web.Request) -> web.Response:
         """Flight-recorder surface: list bundles, fetch a manifest, or
         fetch one bundle file (?name=…&file=…)."""
@@ -657,6 +741,8 @@ def build_app(deps: ServerDeps,
         app.router.add_get("/debug/trace", debug_trace_route)
         app.router.add_get("/decisions/explain", decisions_explain_route)
         app.router.add_get("/debug/incidents", debug_incidents_route)
+        app.router.add_get("/debug/failpoints", debug_failpoints_route)
+        app.router.add_post("/debug/failpoints", debug_failpoints_route)
         app.router.add_get("/traffic/top", traffic_top_route)
         app.router.add_get("/decision_lists", decision_lists_route)
         app.router.add_get("/rate_limit_states", rate_limit_states_route)
@@ -817,7 +903,8 @@ async def run_http_server(
         log.warning(
             "http listener binds non-loopback %s with no admin_token: the "
             "admin surface (/healthz /metrics /debug/trace "
-            "/decisions/explain /debug/incidents /traffic/top) is open to "
+            "/decisions/explain /debug/incidents /debug/failpoints "
+            "/traffic/top) is open to "
             "the network",
             listen_host,
         )

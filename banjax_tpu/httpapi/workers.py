@@ -66,6 +66,9 @@ COLD_ROUTES = (
     # pipeline/matcher run there)
     "/metrics",
     "/debug/trace",
+    # fault-injection admin: failpoints are process-global module state
+    # in the primary (the pipeline/matcher run there)
+    "/debug/failpoints",
     "/decisions/explain",
     "/debug/incidents",
     # traffic introspection (obs/sketch.py): the sketch lives with the

@@ -7,7 +7,10 @@ snapshot (so rate-limit rules hot-reload without restarting the tailer).
 
 The reference uses inotify via hpcloud/tail; here a poll-based follower
 (50 ms idle sleep) keeps the dependency surface zero and handles truncation
-and rotation (size shrink or inode change → reopen from start).
+and rotation (size shrink or inode change → drain the old inode to EOF —
+bytes appended between the last read and the rotation live only there —
+flush the never-terminated trailing line, then reopen from start;
+tests/faults/test_tailer_rotation.py pins the no-drop/no-dup contract).
 
 Resilience: the retry-until-exists loop uses capped jittered exponential
 backoff instead of the reference's flat 5 s clock, the `tailer.open`
@@ -82,6 +85,21 @@ class LogTailer:
             f.seek(0, os.SEEK_END)
         return f
 
+    def _deliver(self, buffer: str) -> str:
+        """Hand every complete line in `buffer` to on_lines; returns the
+        trailing partial line.  One split, not a split-per-line loop: the
+        repeated "rest of buffer" copy is O(n^2) on a big burst, which is
+        exactly when the tailer must keep up."""
+        parts = buffer.split("\n")
+        rest = parts.pop()
+        batch: List[str] = [line for line in parts if line]
+        if batch:
+            try:
+                self.on_lines(batch)
+            except Exception:  # noqa: BLE001 — a bad batch must not kill the tailer
+                log.exception("error consuming log line batch")
+        return rest
+
     def _run(self) -> None:
         f = None
         at_end = True  # first open seeks to EOF; rotation reopens from 0
@@ -116,18 +134,7 @@ class LogTailer:
                     self.health.beat()
                 chunk = f.read(READ_CHUNK_BYTES)
                 if chunk:
-                    buffer += chunk
-                    # one split, not a split-per-line loop: the repeated
-                    # "rest of buffer" copy is O(n^2) on a big burst, which is
-                    # exactly when the tailer must keep up
-                    parts = buffer.split("\n")
-                    buffer = parts.pop()
-                    batch: List[str] = [line for line in parts if line]
-                    if batch:
-                        try:
-                            self.on_lines(batch)
-                        except Exception:  # noqa: BLE001 — a bad batch must not kill the tailer
-                            log.exception("error consuming log line batch")
+                    buffer = self._deliver(buffer + chunk)
                     continue
 
                 # idle: check rotation/truncation
@@ -135,7 +142,26 @@ class LogTailer:
                     st = os.stat(self.path)
                     pos = f.tell()
                     if st.st_ino != inode or st.st_size < pos:
+                        rotated = st.st_ino != inode
+                        # drain the OLD file before closing it: bytes
+                        # appended between our last (empty) read and the
+                        # rotation live only in the old inode — closing
+                        # without this final read drops them (the
+                        # log-rotation-mid-burst scenario caught exactly
+                        # that loss; tests/faults/test_tailer_rotation.py)
+                        while True:
+                            tail = f.read(READ_CHUNK_BYTES)
+                            if not tail:
+                                break
+                            buffer = self._deliver(buffer + tail)
+                        if rotated and buffer:
+                            # the old file is final: a trailing line the
+                            # writer never newline-terminated (rotation
+                            # raced the write) still reaches the matcher
+                            # instead of dying in the parse buffer
+                            self._deliver(buffer + "\n")
                         log.info("log file rotated/truncated; reopening")
+                        buffer = ""
                         f.close()
                         f = None
                         at_end = False

@@ -201,6 +201,43 @@ def render_prometheus(
             flightrec.incident_count,
         )
 
+    # adversarial scenario harness (banjax_tpu/scenarios/stats.py — a
+    # leaf module): last-run rows per attack shape, rendered only when
+    # this process actually ran scenarios
+    try:
+        from banjax_tpu.scenarios.stats import get_stats as _scen_stats
+
+        scen = _scen_stats().prom_snapshot()
+    except Exception:  # noqa: BLE001 — the harness must not break a scrape
+        scen = None
+    if scen is not None and scen["runs_total"]:
+        w.sample(registry.PROM_FAMILIES["banjax_scenario_runs_total"],
+                 scen["runs_total"])
+        w.sample(
+            registry.PROM_FAMILIES[
+                "banjax_scenario_injected_episodes_total"
+            ],
+            scen["episodes_total"],
+        )
+        w.sample(
+            registry.PROM_FAMILIES[
+                "banjax_scenario_invariant_failures_total"
+            ],
+            scen["invariant_failures_total"],
+        )
+        per_gauge = {
+            "lines_per_sec": "banjax_scenario_lines_per_sec",
+            "shed_ratio": "banjax_scenario_shed_ratio",
+            "precision": "banjax_scenario_ban_precision",
+            "recall": "banjax_scenario_ban_recall",
+            "slo_burn_peak": "banjax_scenario_slo_burn_peak",
+        }
+        for name, row in sorted(scen["scenarios"].items()):
+            for field, fam_name in per_gauge.items():
+                if field in row:
+                    w.sample(registry.PROM_FAMILIES[fam_name],
+                             row[field], {"scenario": name})
+
     # component health: aggregate + one labeled gauge per component
     if health is not None:
         snap = health.snapshot()

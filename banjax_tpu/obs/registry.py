@@ -205,6 +205,28 @@ FAMILIES: List[Family] = [
     Family(COUNTER, "incident bundles captured by the flight recorder "
            "(obs/flightrec.py; /debug/incidents)",
            prom="banjax_flightrec_incidents_total"),
+    # ---- adversarial scenario harness (banjax_tpu/scenarios/) ----
+    Family(COUNTER, "scenario-harness runs completed in this process "
+           "(bench --scenarios / the chaos soak)",
+           prom="banjax_scenario_runs_total"),
+    Family(COUNTER, "chaos failpoint episodes injected across scenario "
+           "runs", prom="banjax_scenario_injected_episodes_total"),
+    Family(COUNTER, "scenario invariant failures (accounting, leaked "
+           "turns/pins, benign-SLO, bundle-per-episode)",
+           prom="banjax_scenario_invariant_failures_total"),
+    Family(GAUGE, "last run's end-to-end lines/sec for the labeled "
+           "attack shape", prom="banjax_scenario_lines_per_sec",
+           labels=("scenario",)),
+    Family(GAUGE, "last run's (shed + drain-error) per admitted line "
+           "for the labeled shape", prom="banjax_scenario_shed_ratio",
+           labels=("scenario",)),
+    Family(GAUGE, "last run's ban precision vs the generator oracle",
+           prom="banjax_scenario_ban_precision", labels=("scenario",)),
+    Family(GAUGE, "last run's ban recall vs the generator oracle",
+           prom="banjax_scenario_ban_recall", labels=("scenario",)),
+    Family(GAUGE, "last run's peak SLO burn rate across all SLOs and "
+           "windows", prom="banjax_scenario_slo_burn_peak",
+           labels=("scenario",)),
     # ---- traffic introspection plane (obs/sketch.py; /traffic/top) ----
     Family(COUNTER, "log lines folded into the device traffic sketch "
            "(count-min + HLL + rule pressure)",

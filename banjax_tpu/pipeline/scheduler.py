@@ -706,6 +706,12 @@ class PipelineScheduler:
                 self.stats.note_processed(n)
                 if self._health is not None:
                     self._health.ok()
+            else:
+                # lines lost to a drain failure are an incident like a
+                # shed burst: capture evidence (debounced; outside every
+                # scheduler lock — only this stage thread waits on disk)
+                flightrec.notify("drain-error",
+                                 f"{n} lines counted as shed")
             t_drain_ms = (time.perf_counter() - t0) * 1e3
             batch.root_span.note("ok", ok)
             trace.end(batch.root_span)

@@ -10,16 +10,24 @@ one) a bounded or unbounded number of times.
 Arming:
   * programmatic (tests):  failpoints.arm("matcher.device", count=3)
   * env / config:          BANJAX_FAILPOINTS="matcher.device=error:3;kafka.read=error"
-    (the config key `failpoints` uses the same spec syntax)
+    (the config key `failpoints` uses the same spec syntax; an optional
+    "@p" suffix on an entry — "matcher.device=error:3@0.5" — fires it
+    with probability p per check, from a seeded per-failpoint RNG so a
+    given arming is reproducible)
+  * admin surface:         GET/POST /debug/failpoints (httpapi/server.py)
+    lists armed points and arms/disarms them at runtime — the chaos-soak
+    and operator path that needs no env restart
 
-Instrumented sites in this tree:
+Instrumented sites in this tree (KNOWN_SITES):
   kafka.read       — KafkaReader, before the transport read loop
   kafka.send       — KafkaWriter, before each transport send
   tailer.open      — LogTailer, every file open (start and rotation)
   matcher.device   — TpuMatcher, every device dispatch boundary
+  matcher.resolve  — fused two-phase resolve (turn-release abort path)
   decision_chain   — decision_for_nginx entry (fail-open path)
   pipeline.encode  — pipeline scheduler, encode-stage boundary (a failing
                      batch drains generically; no loss)
+  pipeline.encode_shard — one shard of the sharded encode fan-out
   pipeline.submit  — pipeline scheduler, device submit boundary (breaker
                      failure + CPU-reference drain)
   pipeline.collect — pipeline scheduler, device collect boundary (same)
@@ -31,11 +39,31 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
-from typing import Dict, Optional
+import zlib
+from typing import Dict, List, Optional
 
 log = logging.getLogger(__name__)
+
+# the instrumented sites (module docstring) — served by /debug/failpoints
+# so operators and the scenario harness discover what they can arm
+KNOWN_SITES = (
+    "kafka.read",
+    "kafka.send",
+    "tailer.open",
+    "matcher.device",
+    "matcher.resolve",
+    "decision_chain",
+    "pipeline.encode",
+    "pipeline.encode_shard",
+    "pipeline.submit",
+    "pipeline.collect",
+    "pipeline.drain",
+)
+
+MODES = ("error", "sleep")
 
 
 class FaultInjected(OSError):
@@ -43,16 +71,26 @@ class FaultInjected(OSError):
 
 
 class _Failpoint:
-    __slots__ = ("name", "mode", "remaining", "message", "fired", "delay_s")
+    __slots__ = ("name", "mode", "remaining", "message", "fired", "delay_s",
+                 "probability", "rng")
 
     def __init__(self, name: str, mode: str = "error",
                  count: Optional[int] = None, message: str = "",
-                 delay_s: float = 0.0):
+                 delay_s: float = 0.0, probability: float = 1.0,
+                 seed: Optional[int] = None):
         self.name = name
         self.mode = mode          # "error" | "sleep"
         self.remaining = count    # None = unlimited
         self.message = message or f"failpoint {name} armed"
         self.delay_s = delay_s
+        # probabilistic arming (chaos soak): each check() fires with this
+        # probability, drawn from a PER-FAILPOINT seeded RNG — the default
+        # seed derives from the name, so a given arming replays the same
+        # fire pattern run to run
+        self.probability = min(1.0, max(0.0, float(probability)))
+        self.rng = random.Random(
+            zlib.crc32(name.encode()) if seed is None else seed
+        )
         self.fired = 0
 
 
@@ -69,9 +107,11 @@ def check(name: str) -> None:
         fp = _active.get(name)
         if fp is None:
             return
+        if fp.remaining is not None and fp.remaining <= 0:
+            return
+        if fp.probability < 1.0 and fp.rng.random() >= fp.probability:
+            return  # probabilistic miss: count NOT consumed
         if fp.remaining is not None:
-            if fp.remaining <= 0:
-                return
             fp.remaining -= 1
         fp.fired += 1
         mode, message, delay = fp.mode, fp.message, fp.delay_s
@@ -82,12 +122,15 @@ def check(name: str) -> None:
 
 
 def arm(name: str, mode: str = "error", count: Optional[int] = None,
-        message: str = "", delay_s: float = 0.0) -> None:
+        message: str = "", delay_s: float = 0.0, probability: float = 1.0,
+        seed: Optional[int] = None) -> None:
     global _armed
     with _lock:
-        _active[name] = _Failpoint(name, mode, count, message, delay_s)
+        _active[name] = _Failpoint(name, mode, count, message, delay_s,
+                                   probability, seed)
         _armed = True
-    log.warning("FAILPOINT armed: %s mode=%s count=%s", name, mode, count)
+    log.warning("FAILPOINT armed: %s mode=%s count=%s p=%s",
+                name, mode, count, probability)
 
 
 def disarm(name: Optional[str] = None) -> None:
@@ -113,19 +156,46 @@ def is_armed(name: str) -> bool:
         return fp is not None and (fp.remaining is None or fp.remaining > 0)
 
 
+def snapshot() -> List[dict]:
+    """JSON-ready view of every armed failpoint — the GET
+    /debug/failpoints payload and the chaos soak's episode evidence."""
+    with _lock:
+        return [
+            {
+                "name": fp.name,
+                "mode": fp.mode,
+                "count": fp.remaining,   # None = unlimited
+                "fired": fp.fired,
+                "probability": fp.probability,
+                "delay_s": fp.delay_s,
+            }
+            for fp in _active.values()
+        ]
+
+
 def arm_from_spec(spec: str) -> None:
-    """Parse "name=mode[:count][;name2=..]" (the BANJAX_FAILPOINTS / config
-    syntax).  A bare "name" arms an unlimited error failpoint.  Bad entries
-    are logged and skipped — a typo in a fault spec must not stop a
-    production start."""
+    """Parse "name=mode[:count][@p][;name2=..]" (the BANJAX_FAILPOINTS /
+    config / POST /debug/failpoints spec syntax).  A bare "name" arms an
+    unlimited error failpoint; "@p" fires with probability p per check.
+    Bad entries are logged and skipped — a typo in a fault spec must not
+    stop a production start."""
     for entry in spec.split(";"):
         entry = entry.strip()
         if not entry:
             continue
         name, _, rest = entry.partition("=")
         name = name.strip()
-        mode, count = "error", None
+        mode, count, probability = "error", None, 1.0
         if rest:
+            rest, _, prob_s = rest.partition("@")
+            if prob_s:
+                try:
+                    probability = float(prob_s)
+                except ValueError:
+                    log.warning(
+                        "FAILPOINT: bad probability in spec entry %r", entry
+                    )
+                    continue
             mode, _, count_s = rest.partition(":")
             mode = mode.strip() or "error"
             if count_s:
@@ -134,10 +204,10 @@ def arm_from_spec(spec: str) -> None:
                 except ValueError:
                     log.warning("FAILPOINT: bad count in spec entry %r", entry)
                     continue
-        if mode not in ("error", "sleep"):
+        if mode not in MODES:
             log.warning("FAILPOINT: unknown mode in spec entry %r", entry)
             continue
-        arm(name, mode=mode, count=count)
+        arm(name, mode=mode, count=count, probability=probability)
 
 
 def _load_env() -> None:

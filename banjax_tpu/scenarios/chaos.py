@@ -1,0 +1,123 @@
+"""Seeded chaos schedules: failpoint episodes over a scenario stream.
+
+A ChaosSchedule deterministically places N fault episodes across a
+scenario's event stream.  Each episode arms ONE failpoint
+(resilience/failpoints.py) just before a chosen event index and settles
+it when the next episode starts (or at finish()): the runner records how
+often it actually fired and captures one flight-recorder bundle per
+episode — the per-episode evidence ROADMAP item 4 asks for.  Organic
+captures (breaker trips, shed bursts, SLO breaches) still fire on top;
+the explicit per-episode capture guarantees the evidence floor even for
+faults the engine absorbs without tripping anything.
+
+The default point set is every failpoint on the pipeline's driven path;
+tailer-fed runs add `tailer.open` (rotation reopen faults).  kafka.read/
+kafka.send live on reader/writer loops the runner does not spin up —
+their fault coverage stays in tests/faults/test_kafka_faults.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import List, Optional, Sequence
+
+from banjax_tpu.obs import flightrec
+from banjax_tpu.resilience import failpoints
+
+# failpoints that fire on the ScenarioRunner's driven path
+PIPELINE_POINTS = (
+    "pipeline.encode",
+    "pipeline.submit",
+    "pipeline.collect",
+    "pipeline.drain",
+    "matcher.device",
+    "matcher.resolve",
+)
+TAILER_POINTS = PIPELINE_POINTS + ("tailer.open",)
+
+
+@dataclasses.dataclass
+class Episode:
+    point: str
+    count: int               # bounded injections per episode
+    probability: float
+    at_event: int            # armed just before this event index
+    fired: int = 0           # observed after settlement
+    bundle: Optional[str] = None  # flight-recorder bundle name
+
+
+class ChaosSchedule:
+    def __init__(self, seed: int, n_events: int,
+                 points: Sequence[str] = PIPELINE_POINTS,
+                 episodes: int = 4):
+        rng = random.Random(seed)
+        episodes = max(1, min(episodes, max(1, n_events - 1)))
+        # distinct, sorted injection sites strictly inside the stream
+        sites = sorted(rng.sample(range(1, max(2, n_events)), episodes))
+        order = list(points)
+        rng.shuffle(order)
+        self.episodes: List[Episode] = [
+            Episode(
+                point=order[i % len(order)],
+                count=rng.randint(1, 3),
+                probability=1.0 if rng.random() < 0.7 else 0.5,
+                at_event=site,
+            )
+            for i, site in enumerate(sites)
+        ]
+        self._active: Optional[Episode] = None
+        self._next = 0
+        self._quiesce = None
+
+    # ---- runner hooks ----
+
+    def bind(self, quiesce) -> None:
+        """Install the runner's quiesce callable (pipeline flush): before
+        an episode settles, every batch admitted while it was armed is
+        driven through the armed stage, so `fired` reflects the episode
+        instead of racing the stage threads."""
+        self._quiesce = quiesce
+
+    def before_event(self, index: int) -> None:
+        """Called by the runner before dispatching event `index`."""
+        while (
+            self._next < len(self.episodes)
+            and self.episodes[self._next].at_event <= index
+        ):
+            ep = self.episodes[self._next]
+            self._settle_active()
+            failpoints.arm(
+                ep.point, mode="error", count=ep.count,
+                probability=ep.probability, seed=ep.at_event,
+            )
+            self._active = ep
+            self._next += 1
+
+    def finish(self) -> None:
+        """Settle the last episode; leaves no failpoint armed."""
+        self._settle_active()
+
+    def _settle_active(self) -> None:
+        ep = self._active
+        if ep is None:
+            return
+        if self._quiesce is not None:
+            self._quiesce()
+        ep.fired = failpoints.fired_count(ep.point)
+        failpoints.disarm(ep.point)
+        # the per-episode evidence bundle: captured AFTER the episode so
+        # the trace ring / metrics / provenance show its effect.  The
+        # runner installs a debounce-free recorder, so this never returns
+        # None while one is installed.
+        ep.bundle = flightrec.notify(
+            f"chaos-{ep.point}",
+            f"episode at event {ep.at_event}: count={ep.count} "
+            f"p={ep.probability} fired={ep.fired}",
+        )
+        self._active = None
+
+    # ---- report ----
+
+    def rows(self) -> List[dict]:
+        return [dataclasses.asdict(ep) for ep in self.episodes]

@@ -81,6 +81,15 @@ ban-storm IP rotation so the sketch is actually populated (the banked
 on-row carries sketch_lines/top1 as the witness), banked into
 BENCH_sketch_overhead.json.  Acceptance gate (ISSUE 8): the sketch-on
 row inside the off-run noise band.
+
+Scenario mode: `bench.py --scenarios` — the adversarial scenario
+harness (banjax_tpu/scenarios/): one row per named attack shape (flash
+crowd, slow drip, rotating proxies, command flood, challenge storm,
+log rotation through a real tailer, benign) with lines/s, shed ratio,
+ban precision/recall vs the generator's oracle and SLO burn peaks,
+plus a seeded chaos-soak row with per-failpoint-episode evidence —
+banked into BENCH_scenarios.json.  Knobs: BENCH_SCEN_{SCALE,SEED},
+BENCH_CPU=1.
 """
 
 from __future__ import annotations
@@ -1527,6 +1536,125 @@ def _fused_pipeline_mode() -> None:
 
 
 SINGLE_KERNEL_PATH = os.path.join(_DIR, "BENCH_single_kernel.json")
+SCENARIOS_PATH = os.path.join(_DIR, "BENCH_scenarios.json")
+
+
+def _scenarios_mode() -> None:
+    """`bench.py --scenarios`: one banked row per named attack shape
+    (banjax_tpu/scenarios/) plus a seeded chaos-soak row.
+
+    Every row carries lines/s, shed ratio, ban precision/recall against
+    the generator's ground-truth oracle, per-SLO peak burn rates, and
+    the structural-invariant verdicts — so every future perf PR is
+    judged on hostile shapes, not just the happy-path feed.  The chaos
+    row additionally records each injected failpoint episode (point,
+    fired count, flight-recorder bundle).  Knobs: BENCH_SCEN_SCALE
+    (default 1.0), BENCH_SCEN_SEED, BENCH_CPU=1 for the host backend.
+    """
+    import tempfile
+
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from banjax_tpu.scenarios import (
+        SHAPES,
+        ChaosSchedule,
+        ScenarioRunner,
+        generate,
+    )
+
+    backend = jax.devices()[0].platform
+    scale = float(os.environ.get("BENCH_SCEN_SCALE", "1.0"))
+    seed = int(os.environ.get("BENCH_SCEN_SEED", "20260804"))
+
+    rows = {}
+    with tempfile.TemporaryDirectory(prefix="bench-scen-") as scen_tmp:
+        for name in sorted(SHAPES):
+            sc = generate(name, seed=seed, scale=scale)
+            kwargs = {}
+            if name == "log_rotation":
+                # the rotation shape runs through a REAL file + tailer so
+                # the banked row exercises the reopen-by-inode path
+                kwargs = {
+                    "via_tailer": True,
+                    "tmp_dir": os.path.join(scen_tmp, name),
+                }
+                os.makedirs(kwargs["tmp_dir"], exist_ok=True)
+            rep = ScenarioRunner(sc, **kwargs).run()
+            rows[name] = rep.row()
+            print(json.dumps({
+                "scenario": name,
+                "lines_per_sec": rep.lines_per_sec,
+                "shed_ratio": rep.shed_ratio,
+                "precision": rep.precision,
+                "recall": rep.recall,
+                "invariants_ok": rep.ok(),
+            }), flush=True)
+
+    # the seeded chaos soak: failpoint episodes over the rotating-proxy
+    # worst case, flight recorder armed — banked with per-episode
+    # evidence (this is the row the breaker/shed defaults derive from)
+    chaos_rows = {}
+    with tempfile.TemporaryDirectory() as fr_dir:
+        for name in ("flash_crowd", "rotating_proxies"):
+            sc = generate(name, seed=seed + 1, scale=scale)
+            chaos = ChaosSchedule(
+                seed=seed + 1, n_events=len(sc.events), episodes=5
+            )
+            rep = ScenarioRunner(
+                sc, chaos=chaos,
+                flightrec_dir=os.path.join(fr_dir, name),
+            ).run()
+            chaos_rows[name] = rep.row()
+
+    # derived defaults (PERF.md round 13): breaker window from the
+    # observed episode cadence, latency budget from the clean-shape
+    # device p99 discipline (3x p99, floor 50 ms — the PR 2 rule, now
+    # fed by hostile-shape data instead of a guess)
+    burn_peaks = [
+        max(r["slo_burn_peak"].values() or [0.0])
+        for r in rows.values()
+    ]
+    book = {
+        "metric": "scenario harness: per-shape rows + seeded chaos soak",
+        "backend": backend,
+        "seed": seed,
+        "scale": scale,
+        "measured_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "scenarios": rows,
+        "chaos": chaos_rows,
+        "summary": {
+            "shapes": len(rows),
+            "all_invariants_ok": all(
+                all(r["invariants"].values())
+                for r in list(rows.values()) + list(chaos_rows.values())
+            ),
+            "clean_precision_min": min(
+                r["precision"] for r in rows.values()
+            ),
+            "clean_recall_min": min(r["recall"] for r in rows.values()),
+            "benign_slo_breached": any(
+                rows["benign"]["slo_breached"].values()
+            ),
+            "max_clean_burn_peak": max(burn_peaks) if burn_peaks else 0.0,
+            "chaos_episodes": sum(
+                len(r["episodes"]) for r in chaos_rows.values()
+            ),
+            "chaos_bundles": sum(
+                sum(1 for ep in r["episodes"] if ep["bundle"])
+                for r in chaos_rows.values()
+            ),
+        },
+    }
+    tmp = SCENARIOS_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(book, f, indent=1)
+    os.replace(tmp, SCENARIOS_PATH)
+    print(json.dumps({"metric": book["metric"], **book["summary"]}))
 
 
 def _single_kernel_mode() -> None:
@@ -1942,6 +2070,9 @@ def main() -> None:
         return
     if "--single-kernel" in sys.argv:
         _single_kernel_mode()
+        return
+    if "--scenarios" in sys.argv:
+        _scenarios_mode()
         return
     if "--pipeline" in sys.argv:
         _stream_mode("pipeline")

@@ -7,7 +7,10 @@
 #      registry entry fails HERE, not on a dashboard.  (The pytest
 #      schema-stability suite, tests/unit/test_exposition.py, re-asserts
 #      the same registry against real snapshots in stage 2.)
-#   2. the full tier-1 pytest run (slow-marked tests excluded).
+#   2. the full tier-1 pytest run (slow-marked tests excluded).  This
+#      includes tests/soak/ — the SHORT seeded chaos pass (bounded
+#      wall-clock, ~25 s) runs on every PR; the full-length soak across
+#      every attack shape at scale 1.0 is slow-marked (`-m slow`).
 #
 # Usage: scripts/tier1.sh [extra pytest args]
 

@@ -54,3 +54,64 @@ def test_spec_parsing_good_and_bad_entries():
     assert failpoints.is_armed("two")  # bare name = unlimited error
     assert not failpoints.is_armed("bad")
     assert not failpoints.is_armed("worse")
+
+
+def test_probability_is_seeded_and_deterministic():
+    """p<1 fires from a per-failpoint seeded RNG: two armings with the
+    same seed replay the same fire pattern; the count is only consumed
+    on a fire."""
+    def pattern():
+        failpoints.arm("p.point", count=None, probability=0.5, seed=42)
+        out = []
+        for _ in range(32):
+            try:
+                failpoints.check("p.point")
+                out.append(True)
+            except failpoints.FaultInjected:
+                out.append(False)
+        failpoints.disarm("p.point")
+        return out
+
+    a, b = pattern(), pattern()
+    assert a == b
+    assert any(not x for x in a) and any(x for x in a)  # both outcomes
+
+
+def test_probability_miss_does_not_consume_count():
+    failpoints.arm("p.count", count=1, probability=0.0)
+    for _ in range(10):
+        failpoints.check("p.count")  # never fires, never decrements
+    assert failpoints.fired_count("p.count") == 0
+    assert failpoints.is_armed("p.count")
+    failpoints.disarm("p.count")
+
+
+def test_spec_probability_suffix_and_snapshot():
+    failpoints.arm_from_spec("a.point=error:3@0.25;b.point=error")
+    try:
+        snap = {fp["name"]: fp for fp in failpoints.snapshot()}
+        assert snap["a.point"]["probability"] == 0.25
+        assert snap["a.point"]["count"] == 3
+        assert snap["a.point"]["fired"] == 0
+        assert snap["b.point"]["probability"] == 1.0
+        assert snap["b.point"]["count"] is None
+    finally:
+        failpoints.disarm()
+    assert failpoints.snapshot() == []
+
+
+def test_known_sites_cover_the_instrumented_tree():
+    import subprocess
+
+    # every check("...") call site in the tree is a declared KNOWN_SITE
+    out = subprocess.run(
+        ["grep", "-rho", r'failpoints\.check("[^"]*")', "banjax_tpu/"],
+        capture_output=True, text=True, cwd=str(
+            __import__("pathlib").Path(__file__).resolve().parents[2]
+        ),
+    ).stdout
+    sites = {line.split('"')[1] for line in out.splitlines()}
+    assert sites, "grep found no instrumented sites"
+    assert sites <= set(failpoints.KNOWN_SITES), (
+        sites - set(failpoints.KNOWN_SITES)
+    )

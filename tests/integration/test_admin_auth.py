@@ -35,7 +35,7 @@ regexes_with_rates:
 TOKEN = "sekrit-scraper-token"
 ADMIN_ROUTES = ("/healthz", "/metrics", "/debug/trace",
                 "/decisions/explain?ip=9.9.9.9", "/debug/incidents",
-                "/traffic/top")
+                "/traffic/top", "/debug/failpoints")
 N_ADMIN = len(ADMIN_ROUTES)
 
 
@@ -241,7 +241,8 @@ def test_new_admin_routes_are_worker_proxied():
     from banjax_tpu.httpapi.workers import COLD_ROUTES, install_proxy_routes
 
     for route in ("/decisions/explain", "/debug/incidents",
-                  "/metrics", "/debug/trace", "/healthz", "/traffic/top"):
+                  "/metrics", "/debug/trace", "/healthz", "/traffic/top",
+                  "/debug/failpoints"):
         assert route in COLD_ROUTES, route
 
     app = web.Application()
@@ -251,6 +252,7 @@ def test_new_admin_routes_are_worker_proxied():
     assert "/decisions/explain" in registered
     assert "/debug/incidents" in registered
     assert "/traffic/top" in registered
+    assert "/debug/failpoints" in registered
 
 
 def test_worker_layout_proxies_new_routes_behind_auth():
@@ -422,3 +424,131 @@ def test_traffic_top_without_sketch_reports_disabled():
     status, payload = asyncio.run(go())
     assert status == 200
     assert payload["enabled"] is False and payload["top"] == []
+
+
+def test_debug_failpoints_route_lists_arms_and_disarms():
+    """GET lists sites + armed points; POST arms (count/probability),
+    spec-arms, and disarms — and the armed point actually fires."""
+    from banjax_tpu.resilience import failpoints
+
+    failpoints.disarm()
+    cfg = config_from_yaml_text(RULES_YAML)
+    deps = _deps(cfg)
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        app = server_mod.build_app(deps)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            out = {}
+            r = await client.get("/debug/failpoints")
+            out["list"] = (r.status, await r.json())
+            r = await client.post("/debug/failpoints", json={
+                "arm": [{"name": "pipeline.submit", "count": 2,
+                         "probability": 1.0}],
+                "spec": "kafka.read=error:1",
+            })
+            out["arm"] = (r.status, await r.json())
+            r = await client.post("/debug/failpoints",
+                                  json={"disarm": ["kafka.read"]})
+            out["disarm"] = (r.status, await r.json())
+            r = await client.post("/debug/failpoints",
+                                  json={"arm": [{"mode": "error"}]})
+            out["bad"] = r.status
+            r = await client.post("/debug/failpoints",
+                                  json={"disarm_all": True})
+            out["disarm_all"] = (r.status, await r.json())
+            return out
+        finally:
+            await client.close()
+
+    try:
+        out = asyncio.run(go())
+    finally:
+        failpoints.disarm()
+    status, payload = out["list"]
+    assert status == 200
+    assert "pipeline.submit" in payload["sites"]
+    assert payload["armed"] == []
+    status, payload = out["arm"]
+    assert status == 200
+    armed = {fp["name"]: fp for fp in payload["armed"]}
+    assert armed["pipeline.submit"]["count"] == 2
+    assert armed["kafka.read"]["count"] == 1
+    status, payload = out["disarm"]
+    assert [fp["name"] for fp in payload["armed"]] == ["pipeline.submit"]
+    assert out["bad"] == 400  # arm entry without a name
+    assert out["disarm_all"][1]["armed"] == []
+
+
+def test_debug_failpoints_disabled_by_config():
+    from banjax_tpu.resilience import failpoints
+
+    cfg = config_from_yaml_text(RULES_YAML)
+    cfg.failpoints_admin_enabled = False
+    deps = _deps(cfg)
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        app = server_mod.build_app(deps)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r1 = await client.get("/debug/failpoints")
+            r2 = await client.post(
+                "/debug/failpoints",
+                json={"arm": [{"name": "pipeline.submit"}]},
+            )
+            return r1.status, r2.status
+        finally:
+            await client.close()
+
+    assert asyncio.run(go()) == (403, 403)
+    assert not failpoints.is_armed("pipeline.submit")
+
+
+def test_debug_failpoints_worker_proxied_post():
+    """POST through the worker proxy reaches the primary's module-level
+    failpoint table (the soak's no-restart operator path)."""
+    import tempfile
+
+    from aiohttp import web
+
+    from banjax_tpu.resilience import failpoints
+
+    failpoints.disarm()
+    cfg = config_from_yaml_text(RULES_YAML)
+    deps = _deps(cfg)
+
+    async def go():
+        with tempfile.TemporaryDirectory() as td:
+            sock = f"{td}/primary.sock"
+            primary = server_mod.build_app(deps)
+            prunner = web.AppRunner(primary)
+            await prunner.setup()
+            await web.UnixSite(prunner, sock).start()
+            worker = server_mod.build_app(deps, worker_proxy_sock=sock)
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(worker))
+            await client.start_server()
+            try:
+                r = await client.post("/debug/failpoints", json={
+                    "arm": [{"name": "decision_chain", "count": 1}],
+                })
+                payload = await r.json()
+                return r.status, payload
+            finally:
+                await client.close()
+                await prunner.cleanup()
+
+    try:
+        status, payload = asyncio.run(go())
+        assert status == 200
+        assert [fp["name"] for fp in payload["armed"]] == ["decision_chain"]
+        assert failpoints.is_armed("decision_chain")
+    finally:
+        failpoints.disarm()

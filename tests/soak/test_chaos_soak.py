@@ -1,0 +1,144 @@
+"""Seeded chaos soak: the scenario harness driving the REAL engine.
+
+Tier-1 runs the short pass on every PR (bounded wall-clock: small
+scales, two chaos runs); the full-length pass across every shape at
+scale 1.0 rides behind `-m slow`.
+
+What every run asserts (ScenarioReport.invariants):
+
+  * admitted == processed + shed + drain_errors  (the PR 2 contract)
+  * zero leaked fused order turns, zero leaked device-window slot pins
+  * benign shapes: zero bans AND banjax_slo_breached == 0 end to end
+  * chaos runs: one flight-recorder bundle per injected episode
+"""
+
+import json
+import os
+
+import pytest
+
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.scenarios import ChaosSchedule, ScenarioRunner, generate
+from banjax_tpu.scenarios.chaos import TAILER_POINTS
+
+SEED = 20260804  # the committed soak seed: every CI run replays it
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+
+
+def _assert_invariants(report):
+    assert report.invariants, "no invariants evaluated"
+    bad = {k: v for k, v in report.invariants.items() if not v}
+    assert not bad, (
+        f"scenario {report.name} invariant failures: {bad}\n"
+        f"{json.dumps(report.row(), indent=1, default=str)}"
+    )
+
+
+def test_clean_flash_crowd_matches_oracle_exactly():
+    rep = ScenarioRunner(generate("flash_crowd", SEED, scale=0.25)).run()
+    _assert_invariants(rep)
+    assert rep.precision == 1.0 and rep.recall == 1.0
+    assert rep.oracle_bans > 0  # non-vacuous
+    assert rep.shed_lines == 0 and rep.drain_error_lines == 0
+
+
+def test_clean_slow_drip_does_not_ban_the_paced_drippers():
+    """Precision bait: 90+ paced drippers stay unbanned, the greedy
+    set bans — exactly the oracle's multiset."""
+    rep = ScenarioRunner(generate("slow_drip", SEED, scale=0.3)).run()
+    _assert_invariants(rep)
+    assert rep.precision == 1.0 and rep.recall == 1.0
+    assert 0 < rep.oracle_bans < 10  # only the greedy few
+
+
+def test_benign_scenario_zero_bans_on_both_fused_protocols():
+    """The differential check: the benign shape produces ZERO bans and
+    a clean SLO board on BOTH fused device protocols (single-kernel and
+    the two-program oracle path)."""
+    for mode in ("auto", "off"):
+        rep = ScenarioRunner(
+            generate("benign", SEED, scale=0.1), single_kernel=mode
+        ).run()
+        _assert_invariants(rep)
+        assert rep.engine_bans == 0, mode
+        assert not any(rep.slo_breached.values()), mode
+
+
+def test_command_flood_drains_every_command_in_take_max_batches():
+    rep = ScenarioRunner(generate("command_flood", SEED, scale=0.3)).run()
+    _assert_invariants(rep)
+    assert rep.command_items == rep.n_commands > 0
+    assert rep.precision == 1.0 and rep.recall == 1.0
+
+
+def test_short_seeded_chaos_soak(tmp_path):
+    """The tier-1 chaos pass: a seeded failpoint schedule over the
+    flash-crowd shape, flight recorder armed — invariants hold, every
+    injected episode leaves a bundle, armed episodes actually fired."""
+    sc = generate("flash_crowd", SEED, scale=0.25)
+    chaos = ChaosSchedule(seed=SEED, n_events=len(sc.events), episodes=3)
+    rep = ScenarioRunner(
+        sc, chaos=chaos, flightrec_dir=str(tmp_path / "flightrec")
+    ).run()
+    _assert_invariants(rep)
+    assert len(rep.episodes) >= 2
+    assert all(ep["bundle"] for ep in rep.episodes)
+    assert sum(ep["fired"] for ep in rep.episodes) > 0
+    assert rep.incidents >= len(rep.episodes)
+    # nothing left armed after the soak
+    assert failpoints.snapshot() == [] or all(
+        fp["count"] == 0 for fp in failpoints.snapshot()
+    )
+    # bundles are complete (rename-atomic contract): each has meta.json
+    fdir = str(tmp_path / "flightrec")
+    for name in os.listdir(fdir):
+        assert not name.startswith(".")
+        assert os.path.exists(os.path.join(fdir, name, "meta.json"))
+
+
+def test_chaos_over_tailer_rotation(tmp_path):
+    """Chaos + a real rotating log file: tailer.open faults and pipeline
+    faults layered over the rotation scenario — the accounting and leak
+    invariants must still hold, and nothing the tailer delivered may
+    vanish silently (admitted == processed + shed holds by invariant)."""
+    sc = generate("log_rotation", SEED, scale=0.2)
+    chaos = ChaosSchedule(
+        seed=SEED + 1, n_events=len(sc.events),
+        points=TAILER_POINTS, episodes=3,
+    )
+    rep = ScenarioRunner(
+        sc, chaos=chaos, via_tailer=True, tmp_dir=str(tmp_path),
+        flightrec_dir=str(tmp_path / "flightrec"),
+    ).run()
+    _assert_invariants(rep)
+    assert all(ep["bundle"] for ep in rep.episodes)
+
+
+@pytest.mark.slow
+def test_full_soak_every_shape_clean_and_chaotic(tmp_path):
+    """The full-length soak (-m slow): every named shape at scale 1.0
+    clean, then chaos passes over the two nastiest shapes."""
+    from banjax_tpu.scenarios import SHAPES
+
+    for name in sorted(SHAPES):
+        rep = ScenarioRunner(generate(name, SEED, scale=1.0)).run()
+        _assert_invariants(rep)
+        if not rep.name == "benign":
+            assert rep.precision == 1.0 and rep.recall == 1.0, name
+    for name in ("rotating_proxies", "command_flood"):
+        sc = generate(name, SEED, scale=1.0)
+        chaos = ChaosSchedule(
+            seed=SEED, n_events=len(sc.events), episodes=6
+        )
+        rep = ScenarioRunner(
+            sc, chaos=chaos,
+            flightrec_dir=str(tmp_path / f"fr-{name}"),
+        ).run()
+        _assert_invariants(rep)
+        assert all(ep["bundle"] for ep in rep.episodes)

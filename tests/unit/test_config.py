@@ -221,3 +221,14 @@ def test_provenance_slo_flightrec_keys_defaults_and_validation():
     ):
         with pytest.raises(ValueError):
             config_from_yaml_text(bad)
+
+
+def test_failpoints_admin_key_default_and_typing():
+    cfg = config_from_yaml_text("")
+    assert cfg.failpoints_admin_enabled is True
+
+    cfg = config_from_yaml_text("failpoints_admin_enabled: false\n")
+    assert cfg.failpoints_admin_enabled is False
+
+    with pytest.raises(ValueError, match="failpoints_admin_enabled"):
+        config_from_yaml_text('failpoints_admin_enabled: "yes"\n')

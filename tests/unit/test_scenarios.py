@@ -1,0 +1,228 @@
+"""Scenario-harness unit tier: generator determinism, oracle semantics,
+and the banjax_scenario_* exposition — no engine spin-up here (the
+engine-backed scenario runs live in tests/soak/)."""
+
+import hashlib
+import json
+
+import pytest
+
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.rate_limit import (
+    FailedChallengeRateLimitStates,
+    RegexRateLimitStates,
+)
+from banjax_tpu.obs.exposition import parse_text_format, render_prometheus
+from banjax_tpu.obs import registry
+from banjax_tpu.scenarios import SHAPES, expected_bans, generate
+from banjax_tpu.scenarios.chaos import ChaosSchedule
+from banjax_tpu.scenarios.oracle import precision_recall
+from banjax_tpu.scenarios.shapes import (
+    RULES_YAML,
+    CommandBatch,
+    LineChunk,
+    Rotation,
+    Scenario,
+)
+from banjax_tpu.scenarios.stats import get_stats
+
+
+def _stream_digest(sc) -> str:
+    """Byte-level fingerprint of the COMPLETE event stream (lines,
+    command payloads, rotation markers, in order)."""
+    h = hashlib.sha256()
+    for ev in sc.events:
+        if isinstance(ev, LineChunk):
+            h.update(b"L")
+            for line in ev.lines:
+                h.update(line.encode())
+                h.update(b"\n")
+        elif isinstance(ev, CommandBatch):
+            h.update(b"C")
+            for raw in ev.raws:
+                h.update(raw)
+        elif isinstance(ev, Rotation):
+            h.update(b"R")
+    return h.hexdigest()
+
+
+def test_every_shape_is_seed_deterministic():
+    """Same (name, seed, scale) → byte-identical stream AND identical
+    oracle, for every named shape."""
+    cfg = config_from_yaml_text(RULES_YAML)
+    for name in SHAPES:
+        a = generate(name, seed=99, scale=0.2)
+        b = generate(name, seed=99, scale=0.2)
+        assert _stream_digest(a) == _stream_digest(b), name
+        assert expected_bans(a, cfg) == expected_bans(b, cfg), name
+
+
+def test_different_seed_changes_the_stream():
+    a = generate("flash_crowd", seed=1, scale=0.2)
+    b = generate("flash_crowd", seed=2, scale=0.2)
+    assert _stream_digest(a) != _stream_digest(b)
+
+
+def test_unknown_shape_rejected():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        generate("nope")
+
+
+def test_shape_roster_covers_the_named_attacks():
+    assert set(SHAPES) >= {
+        "flash_crowd", "slow_drip", "rotating_proxies", "command_flood",
+        "challenge_storm", "log_rotation", "benign",
+    }
+    assert len(SHAPES) >= 6
+
+
+def test_benign_oracle_is_empty_and_flagged():
+    sc = generate("benign", seed=3, scale=0.2)
+    cfg = config_from_yaml_text(sc.rules_yaml)
+    assert sc.benign
+    assert expected_bans(sc, cfg) == []
+
+
+def test_timestamps_sorted_and_inside_staleness_window():
+    from banjax_tpu.scenarios.shapes import RUN_NOW
+
+    for name in SHAPES:
+        sc = generate(name, seed=5, scale=0.2)
+        ts = [float(line.split(" ", 1)[0]) for line in sc.lines()]
+        assert ts == sorted(ts), name
+        assert all(RUN_NOW - t <= 10.0 for t in ts), name
+
+
+def test_oracle_reproduces_the_reference_window_quirks():
+    """Hand-built stream: strict-greater window restart, strict-greater
+    exceed, and the reset-to-0-not-1 quirk — checked against the real
+    reference port (decisions/rate_limit.py) AND by hand."""
+    cfg = config_from_yaml_text("""
+regexes_with_rates:
+  - rule: r
+    regex: 'GET /x'
+    interval: 2
+    hits_per_interval: 2
+    decision: nginx_block
+""")
+    t0 = 1_700_000_000.0
+
+    def line(off, ip="7.7.7.7"):
+        return f"{t0 + off:.6f} {ip} GET h.com GET /x HTTP/1.1 ua -"
+
+    # hits at +0, +1, +2 (inside: 2.0 - 0.0 is NOT > 2.0) → count 3 > 2
+    # → ban, reset to 0; +3 (inside vs start 0? 3-0>2 → restart, count 1);
+    # +4, +4.5 → counts 2, 3 → 3 > 2 → second ban
+    sc = Scenario(
+        name="hand", seed=0, scale=1.0, rules_yaml="", benign=False,
+        events=[LineChunk((line(0), line(1), line(2), line(3), line(4),
+                           line(4.5)))],
+    )
+    bans = expected_bans(sc, cfg)
+    assert bans == [("7.7.7.7", "r"), ("7.7.7.7", "r")]
+
+    # differential against the reference port itself
+    states = RegexRateLimitStates()
+    rule = cfg.regexes_with_rates[0]
+    got = []
+    for ln in sc.lines():
+        ts_ns = int(float(ln.split(" ", 1)[0]) * 1e9)
+        _, res = states.apply("7.7.7.7", rule, ts_ns)
+        if res.exceeded:
+            got.append(("7.7.7.7", "r"))
+    assert got == bans
+
+
+def test_precision_recall_multiset_math():
+    eng = [("a", "r"), ("a", "r"), ("b", "r")]
+    orc = [("a", "r"), ("b", "r"), ("c", "r")]
+    p, r, tp = precision_recall(eng, orc)
+    assert tp == 2
+    assert p == pytest.approx(2 / 3)
+    assert r == pytest.approx(2 / 3)
+    assert precision_recall([], []) == (1.0, 1.0, 0)
+    assert precision_recall([("x", "r")], []) == (0.0, 1.0, 0)
+    assert precision_recall([], [("x", "r")]) == (1.0, 0.0, 0)
+
+
+def test_command_flood_chops_past_take_max():
+    sc = generate("command_flood", seed=4, scale=1.0)
+    batches = [ev for ev in sc.events if isinstance(ev, CommandBatch)]
+    assert batches, "command_flood must carry command batches"
+    # at least one batch bigger than the default take bound, so the
+    # encode stage must chop it
+    assert max(len(b.raws) for b in batches) > 1024
+    for raw in batches[0].raws[:4]:
+        cmd = json.loads(raw)
+        assert cmd["Name"] in ("block_ip", "challenge_ip")
+        assert len(cmd["Value"]) > 4
+
+
+def test_log_rotation_carries_markers_and_same_oracle_as_flash_crowd():
+    cfg = config_from_yaml_text(RULES_YAML)
+    rot = generate("log_rotation", seed=6, scale=0.5)
+    flash = generate("flash_crowd", seed=6, scale=0.5)
+    assert sum(isinstance(e, Rotation) for e in rot.events) >= 2
+    # rotation must not change WHAT is expected, only how it is fed
+    assert expected_bans(rot, cfg) == expected_bans(flash, cfg)
+
+
+def test_chaos_schedule_is_seed_deterministic():
+    a = ChaosSchedule(seed=21, n_events=40, episodes=5)
+    b = ChaosSchedule(seed=21, n_events=40, episodes=5)
+    assert a.rows() == b.rows()
+    assert len(a.episodes) == 5
+    sites = [ep.at_event for ep in a.episodes]
+    assert sites == sorted(sites) and len(set(sites)) == len(sites)
+
+
+def test_scenario_families_render_and_declare():
+    """The banjax_scenario_* families: declared in the registry,
+    rendered from the stats module, strictly parseable."""
+    stats = get_stats()
+    stats.reset()
+    try:
+        stats.note_run(
+            "flash_crowd",
+            {"lines_per_sec": 1234.5, "shed_ratio": 0.01,
+             "precision": 1.0, "recall": 0.98, "slo_burn_peak": 2.5},
+            episodes=3, invariant_failures=0,
+        )
+        text = render_prometheus(
+            DynamicDecisionLists(start_sweeper=False),
+            RegexRateLimitStates(), FailedChallengeRateLimitStates(),
+        )
+        fams = parse_text_format(text)
+        for name in (
+            "banjax_scenario_runs_total",
+            "banjax_scenario_injected_episodes_total",
+            "banjax_scenario_invariant_failures_total",
+            "banjax_scenario_lines_per_sec",
+            "banjax_scenario_shed_ratio",
+            "banjax_scenario_ban_precision",
+            "banjax_scenario_ban_recall",
+            "banjax_scenario_slo_burn_peak",
+        ):
+            assert name in fams, name
+            assert name in registry.PROM_FAMILIES, name
+        samples = {
+            (s[0], tuple(sorted(s[1].items()))): s[2]
+            for ent in fams.values() for s in ent["samples"]
+        }
+        key = ("banjax_scenario_ban_recall",
+               (("scenario", "flash_crowd"),))
+        assert samples[key] == pytest.approx(0.98)
+        assert samples[("banjax_scenario_injected_episodes_total",
+                        ())] == 3
+    finally:
+        stats.reset()
+
+
+def test_scenario_families_absent_when_never_ran():
+    get_stats().reset()
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False),
+        RegexRateLimitStates(), FailedChallengeRateLimitStates(),
+    )
+    assert "banjax_scenario_" not in text
