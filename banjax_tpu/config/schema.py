@@ -361,6 +361,29 @@ class Config:
     traffic_sketch_pull_seconds: float = 5.0
     traffic_sketch_topk: int = 32       # heavy-hitter heap size
     traffic_sketch_candidates: int = 8192  # host candidate-IP LRU bound
+    # --- mega-state tiering (matcher/windows.py, native/shmstate.c) ---
+    # sketch-gated slot admission: an IP with no hot/shadow/warm state
+    # only claims a device window slot when the count-min estimate of
+    # its cumulative request count (device sketch + an exact host-side
+    # mirror of refused rows) says it is plausibly over the cheapest
+    # rule threshold.  Refused rows still match and rate-limit through
+    # the stateless host path — the gate changes WHERE state lives,
+    # never the ban multiset; the sketch never undercounts, so gating
+    # delays a ban by at most the admission threshold's worth of rows.
+    # Requires traffic_sketch_enabled + matcher_device_windows.
+    slot_admission_enabled: bool = False
+    # minimum sketch estimate (estimate + current-batch rows) at which
+    # an unseen IP is admitted.  <= 0 (default) derives it from the
+    # loaded ruleset: min(hits_per_interval) + 1 — the smallest count
+    # at which any rule could possibly fire.
+    slot_admission_min_estimate: int = 0
+    # warm tier: on device-slot eviction the victim's per-rule window
+    # vector spills into a shared-memory host table (native/shmstate.c
+    # wt_*) instead of living in the unbounded Python shadow dict, and
+    # refills into a slot on re-admission.  Sized for 10M+ distinct
+    # IPs at ~152 bytes + 24/rule per entry.
+    warm_tier_enabled: bool = False
+    warm_tier_capacity: int = 1 << 20   # entries (rounded up to 2^n)
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -420,6 +443,8 @@ _SCALAR_KEYS = {
     "traffic_sketch_width": int, "traffic_sketch_hll_p": int,
     "traffic_sketch_pull_seconds": float, "traffic_sketch_topk": int,
     "traffic_sketch_candidates": int,
+    "slot_admission_enabled": bool, "slot_admission_min_estimate": int,
+    "warm_tier_enabled": bool, "warm_tier_capacity": int,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -625,6 +650,22 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
             "config keys traffic_sketch_topk/traffic_sketch_candidates: "
             f"expected >= 1, got {cfg.traffic_sketch_topk}/"
             f"{cfg.traffic_sketch_candidates}"
+        )
+    if cfg.slot_admission_enabled and not (
+        cfg.traffic_sketch_enabled and cfg.matcher_device_windows
+    ):
+        raise ValueError(
+            "config key slot_admission_enabled: requires "
+            "traffic_sketch_enabled and matcher_device_windows"
+        )
+    if cfg.warm_tier_enabled and not cfg.matcher_device_windows:
+        raise ValueError(
+            "config key warm_tier_enabled: requires matcher_device_windows"
+        )
+    if cfg.warm_tier_capacity < 1:
+        raise ValueError(
+            "config key warm_tier_capacity: expected >= 1, got "
+            f"{cfg.warm_tier_capacity}"
         )
     if cfg.flightrec_keep < 1 or cfg.flightrec_provenance_records < 1:
         raise ValueError(

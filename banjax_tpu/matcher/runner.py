@@ -238,6 +238,8 @@ class TpuMatcher(Matcher):
         self.device_windows = None
         self._active_table = None
         self.traffic_sketch = None
+        self._slot_admission = False
+        self._admission_min_estimate = 1
         self._host_row: Dict[str, int] = {}
         if getattr(config, "matcher_device_windows", False):
             from banjax_tpu.matcher.windows import DeviceWindows
@@ -246,6 +248,10 @@ class TpuMatcher(Matcher):
                 [r for _, r in self._entries],
                 capacity=getattr(config, "matcher_window_capacity", 0),
                 native_slotmgr=getattr(config, "slotmgr_native", True),
+                warm_tier_enabled=getattr(config, "warm_tier_enabled", False),
+                warm_tier_capacity=getattr(
+                    config, "warm_tier_capacity", 1 << 20
+                ),
             )
             # active_table[h, rid]: rule rid applies to lines of host row h
             # (per-site rules of that host + global rules), minus
@@ -290,6 +296,27 @@ class TpuMatcher(Matcher):
                         config, "traffic_sketch_candidates", 8192
                     ),
                 )
+
+            # cold-tier slot admission (mega-state tiering): an UNSEEN ip
+            # claims a hot-tier slot only when the sketch estimate says it
+            # plausibly crosses the cheapest rule threshold.  Requires the
+            # sketch (the estimates) — admission silently stays off
+            # without it.  min_estimate 0 derives the cheapest threshold
+            # from the ruleset: min(hits_per_interval) + 1 is the
+            # earliest row count at which ANY rule can fire.
+            self._slot_admission = bool(
+                getattr(config, "slot_admission_enabled", False)
+            ) and self.traffic_sketch is not None
+            me = int(getattr(config, "slot_admission_min_estimate", 0))
+            if me <= 0:
+                me = max(
+                    1,
+                    min(
+                        (r.hits_per_interval for _, r in self._entries),
+                        default=0,
+                    ) + 1,
+                )
+            self._admission_min_estimate = me
 
         self._mesh_matcher = None
         if self._mesh_rp:
@@ -679,6 +706,17 @@ class TpuMatcher(Matcher):
         if not len(work):
             return results
 
+        # 1b. cold-tier slot admission: refused rows take the classic
+        #     per-line host path (matched device-statelessly, windows
+        #     applied host-side into the warm tier); admitted rows
+        #     continue below with hot-tier slots
+        part = self._partition_admission(work, pre_encoded)
+        if part is not None:
+            work, pre_encoded, work_r, pre_r = part
+            self._consume_refused(work_r, pre_r, results)
+            if not len(work):
+                return results
+
         # 2a. fully-fused pipeline: match + window apply in ONE device
         #     dispatch (matcher/fused_windows.py) — no dense bitmap ever
         #     crosses the host boundary. Eligible when every rule is
@@ -858,6 +896,19 @@ class TpuMatcher(Matcher):
     def pipeline_submit(self, state: dict, now: Optional[float] = None) -> None:
         if not len(state["work"]):
             return
+        part = self._partition_admission(state["work"], state["pre"])
+        if part is not None:
+            state["work"], state["pre"], work_r, pre_r = part
+            # refused rows apply SYNCHRONOUSLY at submit: submits are
+            # sequential on the scheduler thread, so this batch's
+            # warm-tier writes land before the NEXT batch's admission
+            # probe/refill — a refused IP can never race its own state.
+            # (Their results ride state["results"] out at finish; the
+            # shrunk work keeps host_eval all-false, so fused
+            # eligibility computed at begin remains valid.)
+            self._consume_refused(work_r, pre_r, state["results"])
+            if not len(state["work"]):
+                return
         if state.get("fused_eligible") and self._single_kernel_ordered():
             if self._submit_fused_pipeline(state, now):
                 return
@@ -1293,6 +1344,95 @@ class TpuMatcher(Matcher):
             except Exception:  # noqa: BLE001 — sketch is passive by contract
                 log.exception("traffic sketch slot-table refresh failed")
         return uslots[uinv]
+
+    # ---- cold-tier slot admission (mega-state tiering) ----
+
+    def _partition_admission(self, work, pre_encoded):
+        """Split one batch at the slot-admission gate.  Returns None when
+        admission is off or every row admitted; else
+        (work_admitted, pre_admitted, work_refused, pre_refused) —
+        row-disjoint takes of the batch, partitioned per DISTINCT ip so
+        all of an IP's rows land on one side (per-IP event order is
+        therefore untouched; only cross-IP interleaving can differ from
+        the ungated engine).
+
+        The gate admits on `estimate + this batch's row count`, so an IP
+        whose cumulative rows reach the threshold is admitted in THAT
+        batch: a refused IP has strictly fewer than min_estimate total
+        rows behind it — the bounded-ban-delay invariant the
+        differential suite asserts.  Refused counts then fold into the
+        sketch's exact host mirror so the next batch's estimate sees
+        them.  Any gate failure admits the whole batch (fail open)."""
+        if (
+            not self._slot_admission
+            or self.device_windows is None
+            or self.traffic_sketch is None
+            or not len(work)
+        ):
+            return None
+        try:
+            uips, uinv = work.unique_ips()
+            counts = np.bincount(uinv, minlength=len(uips)).astype(np.int64)
+            sk = self.traffic_sketch
+            hashes = sk.base_hashes(uips)
+            est = sk.estimate_ips(uips, hashes=hashes) + counts
+            mask_u = self.device_windows.admission_mask(
+                uips,
+                estimates=est,
+                min_estimate=self._admission_min_estimate,
+                counts=counts,
+            )
+            if mask_u.all():
+                return None
+            refused_u = np.flatnonzero(~mask_u)
+            sk.fold_refused(
+                [uips[int(i)] for i in refused_u],
+                counts[refused_u],
+                hashes=hashes[refused_u],
+            )
+            row_mask = mask_u[uinv]
+            adm = np.flatnonzero(row_mask)
+            ref = np.flatnonzero(~row_mask)
+            work_a, work_r = work.take(adm), work.take(ref)
+            pre_a = pre_r = None
+            if pre_encoded is not None:
+                cls_ids, lens, host_eval = pre_encoded
+                pre_a = (cls_ids[adm], lens[adm], host_eval[adm])
+                pre_r = (cls_ids[ref], lens[ref], host_eval[ref])
+            return work_a, pre_a, work_r, pre_r
+        except Exception:  # noqa: BLE001 — the gate is an optimization; fail open
+            log.exception("slot-admission gate failed; admitting batch")
+            return None
+
+    def _consume_refused(self, work, pre_encoded, results) -> None:
+        """Classic per-line path for slot-REFUSED rows: device-STATELESS
+        match (no slot claimed, no device window state touched), then the
+        window transitions applied host-side in the canonical
+        (line, rule_id) order — apply_host_events replicates _window_step
+        exactly and homes the state in the warm tier, so a refused IP
+        that matched anything is admitted next batch.  Effects replay
+        through the same _replay_window_events as every other path
+        (Banner, provenance, rule pressure — full parity)."""
+        if not len(work):
+            return
+        bits = self._match_bits(work, pre_encoded)
+        events_in = []
+        row_any = bits.any(axis=1)
+        for row in np.flatnonzero(row_any):
+            row = int(row)
+            _, p = work[row]
+            pos = self._rule_pos(p.host)
+            # applicable rule ids ascending == per-site-then-global
+            # (per-site ids precede global ids in self._entries)
+            for idx in sorted(
+                x for x in np.nonzero(bits[row])[0].tolist() if x in pos
+            ):
+                _, rule = self._entries[idx]
+                if rule.hosts_to_skip.get(p.host):
+                    continue  # no window event — active_table parity
+                events_in.append((row, idx, p.ip, p.timestamp_ns))
+        events = self.device_windows.apply_host_events(events_in)
+        self._replay_window_events(work, bits, None, events, results)
 
     def _native_gate(self, nb, lines, now, results, use_scratch=True):
         """Vectorized step 1 over a native ParsedBatch: flag masks, unique

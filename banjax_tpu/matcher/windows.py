@@ -42,6 +42,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import threading
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -338,6 +339,9 @@ class DeviceWindows:
         capacity: int = 16384,  # matcher_window_capacity; 0 = auto-size
         max_events: int = 4096,
         native_slotmgr: bool = True,
+        warm_tier=None,             # pre-built tier object (tests inject)
+        warm_tier_enabled: bool = False,
+        warm_tier_capacity: int = 1 << 20,
     ):
         self.n_rules = max(1, len(rules))
         # capacity 0 = auto: start small, double on occupancy pressure
@@ -364,14 +368,53 @@ class DeviceWindows:
         limits = np.zeros(self.n_rules, dtype=np.int32)
         iv_s = np.zeros(self.n_rules, dtype=np.int32)
         iv_ns = np.zeros(self.n_rules, dtype=np.int32)
+        iv_total = np.zeros(self.n_rules, dtype=np.int64)
         self._rule_names: List[str] = []
         for i, r in enumerate(rules):
             limits[i] = r.hits_per_interval
             iv_s[i], iv_ns[i] = divmod(int(r.interval_ns), _NS_PER_S)
+            iv_total[i] = int(r.interval_ns)
             self._rule_names.append(r.rule)
         self._limits = jnp.asarray(limits)
         self._iv_s = jnp.asarray(iv_s)
         self._iv_ns = jnp.asarray(iv_ns)
+        # host copies for the refused-row window apply (apply_host_events
+        # replicates _window_step in exact int64 arithmetic)
+        self._limits_np = limits
+        self._iv_total_np = iv_total
+
+        # --- mega-state tiering (warm tier + cold-tier admission) ---
+        # Warm tier: evicted hot-tier state spills HERE (shadow entry
+        # moves into the bounded shm table) instead of accumulating in
+        # the unbounded host shadow; a returning IP refills
+        # byte-identically on slot claim.  None = warm tier off — the
+        # pre-tiering behavior (shadow keeps everything) is unchanged.
+        self._warm = warm_tier
+        if self._warm is None and warm_tier_enabled:
+            from banjax_tpu.native.shm import create_warm_tier
+
+            # steal horizon: twice the widest rule window — an entry
+            # whose every window could have expired is semantically a
+            # restart-as-first-seen, so stealing it loses nothing
+            expiry = max(60 * _NS_PER_S, 2 * int(iv_total.max() or 0))
+            self._warm = create_warm_tier(
+                capacity=warm_tier_capacity,
+                max_rules=self.n_rules,
+                expiry_ns=expiry,
+            )
+        self.warm_spills = 0
+        self.warm_refills = 0
+        # Cold-tier admission bookkeeping (admission_mask): refused rows
+        # are counted, never dropped — the runner still matches and
+        # host-applies them.  FP accounting: a slot claimed on a sketch
+        # estimate is marked; if its tenure ends with the IP having
+        # matched nothing, the admission was a sketch overcount.
+        self.slot_refusals = 0
+        self.sketch_admissions = 0
+        self.sketch_fp_evaluated = 0
+        self.sketch_fp_count = 0
+        self._sketch_pending: set = set()
+        self._sketch_slots: Dict[int, bool] = {}
 
         self._slots: Dict[str, int] = {}  # ip → slot
         # batch-granular recency per slot (see slots_for_unique_ips)
@@ -521,16 +564,102 @@ class DeviceWindows:
                 self._slots[ip] = slot
                 self._slot_ip[slot] = ip
                 self._last_used[slot] = self._batch_seq
+                if self._sketch_pending and ip in self._sketch_pending:
+                    self._sketch_pending.discard(ip)
+                    self._sketch_slots[slot] = True
                 if ip in self._shadow:
                     # previously-evicted IP returns: its counters re-enter
                     # the device in the next maintenance step, BEFORE any
                     # of this batch's events for it are applied
                     self._pending_restore.append((slot, ip))
+                elif self._warm is not None and len(self._warm):
+                    self._refill_from_warm_locked(slot, ip)
                 out[i] = slot
             # out holds DISTINCT slots (distinct ips map to distinct
             # slots), so a vectorized increment pins each exactly once
             self._pin_counts[out] += 1
             return out
+
+    def admission_mask(
+        self,
+        ips: Sequence[str],
+        estimates: Optional[np.ndarray] = None,
+        min_estimate: int = 1,
+        counts: Optional[np.ndarray] = None,
+    ) -> np.ndarray:
+        """Cold-tier slot admission over a DISTINCT ip list: bool [n],
+        True = the IP may claim a hot-tier slot this batch.
+
+        Admission order (first hit wins):
+          1. already hot (slot assigned) — membership probe only, NO
+             recency stamp, so a refused batch cannot refresh its probe
+             victims' LRU position;
+          2. known state elsewhere (host shadow or warm tier) — a
+             returning IP always re-enters (the refill path needs the
+             slot);
+          3. unseen: admitted iff the traffic sketch plausibly puts it
+             over the cheapest rule threshold (estimates[i] >=
+             min_estimate).  The count-min estimate never undercounts,
+             so a real offender is delayed at most min_estimate lines —
+             never missed.
+
+        `estimates=None` admits every unseen IP (admission off).
+        `counts` (per-ip row counts) weights the refusal counter by
+        rows, not distinct IPs.  Refused rows are NOT dropped — the
+        runner matches them device-statelessly and applies their window
+        transitions host-side (apply_host_events)."""
+        n = len(ips)
+        with self._lock:
+            if n == 0:
+                return np.zeros(0, dtype=bool)
+            if self._sm is not None:
+                admit = self._sm.contains_batch(ips)
+            else:
+                slots = self._slots
+                admit = np.fromiter(
+                    (ip in slots for ip in ips), dtype=bool, count=n
+                )
+            unknown = np.flatnonzero(~admit)
+            if len(unknown):
+                shadow = self._shadow
+                if shadow:
+                    sh = np.fromiter(
+                        (ips[int(i)] in shadow for i in unknown),
+                        dtype=bool, count=len(unknown),
+                    )
+                    admit[unknown[sh]] = True
+                    unknown = unknown[~sh]
+            if (
+                len(unknown)
+                and self._warm is not None
+                and len(self._warm)
+            ):
+                wm = self._warm.contains_batch(
+                    [ips[int(i)] for i in unknown]
+                )
+                admit[unknown[wm]] = True
+                unknown = unknown[~wm]
+            if len(unknown):
+                if estimates is None:
+                    admit[unknown] = True
+                else:
+                    est_ok = (
+                        np.asarray(estimates)[unknown]
+                        >= min_estimate
+                    )
+                    admitted = unknown[est_ok]
+                    admit[admitted] = True
+                    for i in admitted:
+                        self._sketch_pending.add(ips[int(i)])
+                    self.sketch_admissions += int(est_ok.sum())
+                    refused = unknown[~est_ok]
+                    if counts is not None:
+                        self.slot_refusals += int(
+                            np.asarray(counts)[refused].sum()
+                        )
+                    else:
+                        self.slot_refusals += len(refused)
+            return admit
 
     def _slots_unique_native_locked(self, ips: Sequence[str]) -> Optional[np.ndarray]:
         """slots_for_unique_ips via the native manager: one C lookup pass
@@ -572,7 +701,7 @@ class DeviceWindows:
         if len(evicted):
             ev = [int(s) for s in evicted]
             for s in ev:
-                self._slot_ip.pop(s, None)
+                self._note_eviction_locked(s, self._slot_ip.pop(s, None))
             self._pending_evict.extend(ev)
             if self.eviction_count == 0:
                 self._warn_first_eviction()
@@ -587,13 +716,29 @@ class DeviceWindows:
             # C-speed mirror update: at the all-distinct-IP shape this
             # loop IS the residual host cost, so no per-entry Python
             slot_ip.update(zip(slot_l, ip_l))
-            if shadow:
+            pend_sketch = self._sketch_pending
+            if pend_sketch:
                 for slot, ip in zip(slot_l, ip_l):
+                    if ip in pend_sketch:
+                        pend_sketch.discard(ip)
+                        self._sketch_slots[slot] = True
+            # warm membership in ONE C probe over the placed ips; takes
+            # only on hits — the all-distinct shape (misses everywhere)
+            # pays one batch call, not a per-ip round-trip
+            warm = self._warm
+            in_warm = (
+                warm.contains_batch(ip_l)
+                if warm is not None and len(warm) else None
+            )
+            if shadow or in_warm is not None:
+                for k, (slot, ip) in enumerate(zip(slot_l, ip_l)):
                     if ip in shadow:
                         # previously-evicted IP returns: counters re-enter
                         # the device in the next maintenance step, BEFORE
                         # any of this batch's events for it are applied
                         pend_restore.append((slot, ip))
+                    elif in_warm is not None and in_warm[k]:
+                        self._refill_from_warm_locked(slot, ip)
         if not ok:
             return None  # every eviction candidate pinned — split
         self._pin_counts[slots] += 1
@@ -632,11 +777,48 @@ class DeviceWindows:
             return None
         victim_ip = self._slot_ip.pop(victim)
         self._slots.pop(victim_ip)
+        self._note_eviction_locked(victim, victim_ip)
         self._pending_evict.append(victim)
         if self.eviction_count == 0:
             self._warn_first_eviction()
         self.eviction_count += 1
         return victim
+
+    def _note_eviction_locked(self, slot: int, ip: Optional[str]) -> None:
+        """Tiering bookkeeping at hot-tier eviction: FP-evaluate a
+        sketch-admitted tenure (no state at eviction = the sketch
+        overcounted) and spill the victim's shadow entry into the warm
+        tier.  On a warm-tier drop (probe window full of live records)
+        the shadow KEEPS the entry — pre-tiering lossless behavior; the
+        tier's `dropped` counter surfaces the sizing pressure."""
+        if self._sketch_slots.pop(slot, False):
+            self.sketch_fp_evaluated += 1
+            if ip is None or ip not in self._shadow:
+                self.sketch_fp_count += 1
+        if self._warm is None or ip is None:
+            return
+        od = self._shadow.get(ip)
+        if not od:
+            return
+        entries = [(rid, h, s, ns) for rid, (h, s, ns) in od.items()]
+        if self._warm.put(ip, entries, time.time_ns()):
+            del self._shadow[ip]
+            self.warm_spills += 1
+
+    def _refill_from_warm_locked(self, slot: int, ip: str) -> bool:
+        """Move one IP's window vector warm → shadow and queue the device
+        restore (the same next-maintenance path a shadow hit takes, so
+        the counters re-enter the device BEFORE any of this batch's
+        events for the IP)."""
+        ent = self._warm.take(ip)
+        if ent is None:
+            return False
+        self._shadow[ip] = OrderedDict(
+            (rid, (h, s, ns)) for rid, h, s, ns in ent
+        )
+        self._pending_restore.append((slot, ip))
+        self.warm_refills += 1
+        return True
 
     def _grow_locked(self, new_capacity: int) -> None:
         """Double the slot table in place (auto-size): pad the flat device
@@ -717,13 +899,42 @@ class DeviceWindows:
             self._pending_restore = []
             self._pin_counts = np.zeros(self.capacity, dtype=np.int32)
             self._last_used = np.zeros(self.capacity, dtype=np.int64)
+            if self._warm is not None:
+                self._warm.clear()
+            self._sketch_pending.clear()
+            self._sketch_slots.clear()
             self._state = self._fresh_state()
 
     def __len__(self) -> int:
         # parity with RegexRateLimitStates.__len__: IPs with any state —
-        # including evicted ones (the reference never forgets)
+        # including evicted ones (the reference never forgets; warm and
+        # shadow populations are disjoint by construction)
         with self._lock:
-            return len(self._shadow)
+            warm = len(self._warm) if self._warm is not None else 0
+            return len(self._shadow) + warm
+
+    # ---- tier gauges (obs/stats.py snapshot surface) ----
+
+    @property
+    def warm_occupancy(self) -> int:
+        return len(self._warm) if self._warm is not None else 0
+
+    @property
+    def warm_capacity(self) -> int:
+        return int(self._warm.capacity) if self._warm is not None else 0
+
+    @property
+    def warm_dropped(self) -> int:
+        return int(self._warm.dropped) if self._warm is not None else 0
+
+    @property
+    def sketch_admission_fp_rate(self) -> float:
+        """Of sketch-admitted slots whose tenure ENDED (evicted), the
+        fraction that never matched any rule — the realized cost of
+        count-min overcounting, measurable without ground truth."""
+        if not self.sketch_fp_evaluated:
+            return 0.0
+        return self.sketch_fp_count / self.sketch_fp_evaluated
 
     # ---- the batch step ----
 
@@ -907,6 +1118,88 @@ class DeviceWindows:
             _pad(r_slots, self.capacity, ks),
         )
 
+    # ---- refused-row host apply (cold-tier path) ----
+
+    def apply_host_events(
+        self, events: Sequence[Tuple[int, int, str, int]]
+    ) -> List[WindowEvent]:
+        """Window transitions for REFUSED rows — the slot-admission
+        gate's classic per-line path.  `events` is a list of
+        (row, rule_id, ip, ts_ns), pre-sorted by (row, rule_id)
+        ascending — the reference processing order (per-site rule ids
+        precede global ids, so this IS the per-site-then-global loop).
+
+        Replicates _window_step exactly, in int64 nanoseconds (the host
+        oracle's own arithmetic — the (s, ns) split on device is
+        bit-identical to this by construction): restart strictly-
+        greater-than interval, hits reset to 0 (not 1) on exceed,
+        FirstTime/OutsideInterval/InsideInterval, seen_ip = "the IP had
+        any state before this event".
+
+        State home: the touched vectors are written back to the warm
+        tier (shadow when the warm tier is off or the put drops), so a
+        refused IP that matched anything is warm-resident — and
+        therefore ADMITTED next batch (admission rule 2), which bounds
+        the ban delay to the single batch in which the sketch estimate
+        first crossed the threshold."""
+        out: List[WindowEvent] = []
+        if not events:
+            return out
+        with self._lock:
+            touched: "Dict[str, OrderedDict]" = {}
+            warm = self._warm
+            warm_live = warm is not None and len(warm) > 0
+            for row, rid, ip, ts_ns in events:
+                od = touched.get(ip)
+                if od is None:
+                    od = self._shadow.get(ip)
+                    if od is None and warm_live:
+                        ent = warm.take(ip)
+                        if ent is not None:
+                            od = OrderedDict(
+                                (r, (h, s, ns)) for r, h, s, ns in ent
+                            )
+                    if od is None:
+                        od = OrderedDict()
+                    touched[ip] = od
+                seen = bool(od)
+                st = od.get(rid)
+                have = st is not None
+                outside = False
+                if have:
+                    h0, s0, n0 = st
+                    outside = (
+                        int(ts_ns) - (s0 * _NS_PER_S + n0)
+                        > int(self._iv_total_np[rid])
+                    )
+                if not have or outside:
+                    h1 = 1
+                    s1, n1 = divmod(int(ts_ns), _NS_PER_S)
+                else:
+                    h1 = h0 + 1
+                    s1, n1 = s0, n0
+                exceeded = h1 > int(self._limits_np[rid])
+                od[rid] = (0 if exceeded else h1, s1, n1)
+                mtype = 0 if not have else (1 if outside else 2)
+                out.append(WindowEvent(
+                    line=int(row), rule_id=int(rid),
+                    match_type=RateLimitMatchType(mtype),
+                    exceeded=bool(exceeded), seen_ip=seen,
+                ))
+            now_ns = time.time_ns()
+            for ip, od in touched.items():
+                if warm is not None:
+                    entries = [
+                        (rid, h, s, ns) for rid, (h, s, ns) in od.items()
+                    ]
+                    if warm.put(ip, entries, now_ns):
+                        self._shadow.pop(ip, None)
+                        self.warm_spills += 1
+                        continue
+                # warm off (or the put dropped): the shadow is the home
+                self._shadow[ip] = od
+        return out
+
     # ---- introspection parity with RegexRateLimitStates ----
     # The host shadow (updated from every batch's event-final states) is the
     # authoritative introspection source: no device pull, and it includes
@@ -915,6 +1208,12 @@ class DeviceWindows:
     def get(self, ip: str) -> Tuple[Dict[str, NumHitsAndIntervalStart], bool]:
         with self._lock:
             od = self._shadow.get(ip)
+            if not od and self._warm is not None:
+                ent = self._warm.peek(ip)
+                if ent:
+                    od = OrderedDict(
+                        (r, (h, s, ns)) for r, h, s, ns in ent
+                    )
             if not od:
                 return {}, False  # seen at parse time but no event yet
             return {
@@ -927,6 +1226,15 @@ class DeviceWindows:
     def format_states(self) -> str:
         with self._lock:
             rows = [(ip, list(od.items())) for ip, od in self._shadow.items()]
+            if self._warm is not None and len(self._warm):
+                # warm-resident IPs are disjoint from the shadow (spill
+                # deletes the shadow entry), so this is a plain append
+                for ip in self._warm.keys():
+                    ent = self._warm.peek(ip)
+                    if ent:
+                        rows.append(
+                            (ip, [(r, (h, s, ns)) for r, h, s, ns in ent])
+                        )
         if not rows:
             return ""
         lines: List[str] = []

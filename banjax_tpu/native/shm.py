@@ -115,6 +115,38 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.fc_test_lock_slot.argtypes = [vp, ctypes.c_int64, ctypes.c_int32]
         lib.fc_test_slot_owner.restype = ctypes.c_int32
         lib.fc_test_slot_owner.argtypes = [vp, ctypes.c_int64]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.wt_init.restype = ctypes.c_int64
+        lib.wt_init.argtypes = [vp, ctypes.c_int64, ctypes.c_int64]
+        lib.wt_check.restype = ctypes.c_int64
+        lib.wt_check.argtypes = [vp]
+        lib.wt_len.restype = ctypes.c_int64
+        lib.wt_len.argtypes = [vp]
+        lib.wt_dropped.restype = ctypes.c_int64
+        lib.wt_dropped.argtypes = [vp]
+        lib.wt_clear.restype = None
+        lib.wt_clear.argtypes = [vp]
+        lib.wt_put.restype = ctypes.c_int64
+        lib.wt_put.argtypes = [
+            vp, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int64, i32p, i32p, i64p, i64p, ctypes.c_int64,
+        ]
+        lib.wt_take.restype = ctypes.c_int64
+        lib.wt_take.argtypes = [
+            vp, ctypes.c_char_p, ctypes.c_int32, i32p, i32p, i64p, i64p,
+        ]
+        lib.wt_get.restype = ctypes.c_int64
+        lib.wt_get.argtypes = [
+            vp, ctypes.c_char_p, ctypes.c_int32, i32p, i32p, i64p, i64p,
+        ]
+        lib.wt_snapshot_keys.restype = ctypes.c_int64
+        lib.wt_snapshot_keys.argtypes = [
+            vp, ctypes.c_char_p, i32p, ctypes.c_int64,
+        ]
+        lib.wt_contains_batch.restype = ctypes.c_int64
+        lib.wt_contains_batch.argtypes = [
+            vp, u8p, i64p, i64p, ctypes.c_int64, u8p,
+        ]
         _LIB = lib
         return _LIB
 
@@ -266,3 +298,310 @@ class ShmFailedChallengeStates:
                 self._shm.unlink()
             except FileNotFoundError:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Warm-tier IP window store (mega-state tiering).
+#
+# The middle tier of the three-tier hierarchy: device slots (hot) spill a
+# victim's full per-rule (num_hits, interval_start) vector here on
+# eviction, and a returning IP refills byte-identically on slot claim.
+# Entry order inside a record is preserved (the hot tier's shadow is an
+# OrderedDict; insertion order is part of the round-trip contract).
+#
+# Two implementations with one interface:
+#   ShmWarmTier — the C table appended to shmstate.c (wt_*), backed by a
+#       shared-memory segment; O(1) probe-bounded put/take and a single
+#       batched membership call per admission check.
+#   PyWarmTier  — bounded-OrderedDict fallback when no C compiler is
+#       available; same steal-iff-expired / drop-and-count overflow
+#       policy, approximated globally instead of per probe window (it
+#       drops strictly less often, never more).
+#
+# Both are externally locked by DeviceWindows, like slotmgr.
+
+
+# (rule_id, num_hits, interval_start_s, interval_start_ns) — exactly the
+# shadow map's value tuple with the rule id made explicit
+WarmEntries = List[Tuple[int, int, int, int]]
+
+WT_KEY_MAX = 104
+WT_REC_HEADER_BYTES = 128
+WT_ENTRY_BYTES = 24
+
+
+def _wt_key(ip: str) -> bytes:
+    # same empty-key sentinel as the fc table: key_len 0 means "empty
+    # slot" in C, so the empty ip maps to one NUL byte
+    return ip.encode("utf-8", "surrogatepass")[:WT_KEY_MAX] or b"\x00"
+
+
+class ShmWarmTier:
+    """Warm-tier table over a shared-memory segment (wt_* in shmstate.c).
+
+    All calls must be externally locked — DeviceWindows holds its own
+    lock around every use, the slotmgr convention.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 1 << 20,
+        max_rules: int = 16,
+        expiry_ns: int = 300 * 1_000_000_000,
+        name: Optional[str] = None,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native shmstate unavailable (no C compiler?)")
+        self._lib = lib
+        cap = 1
+        while cap < max(2, capacity):
+            cap *= 2
+        self.capacity = cap
+        self.max_rules = max(1, int(max_rules))
+        self.expiry_ns = int(expiry_ns)
+        stride = WT_REC_HEADER_BYTES + self.max_rules * WT_ENTRY_BYTES
+        size = HEADER_BYTES + cap * stride
+        if name is None:
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            self.owner = True
+            self._map_base()
+            if lib.wt_init(self._base_ptr, cap, self.max_rules) != 0:
+                raise ValueError(f"bad warm-tier geometry {cap}x{max_rules}")
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            self.owner = False
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(self._shm._name, "shared_memory")
+            except Exception:  # noqa: BLE001 — tracker internals shifted
+                pass
+            self._map_base()
+            if lib.wt_check(self._base_ptr) < 0:
+                raise RuntimeError(f"shm segment {name} is not a wt table")
+        # scratch output arrays reused by take/get (max_rules is small)
+        self._rid = np.zeros(self.max_rules, dtype=np.int32)
+        self._hits = np.zeros(self.max_rules, dtype=np.int32)
+        self._ss = np.zeros(self.max_rules, dtype=np.int64)
+        self._sns = np.zeros(self.max_rules, dtype=np.int64)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    _map_base = ShmFailedChallengeStates._map_base
+
+    def put(self, ip: str, entries: WarmEntries, now_ns: int) -> bool:
+        """Spill one IP's window vector; False when the put was dropped
+        (probe window full of live, unexpired records)."""
+        base = self._base_ptr
+        if base is None or not entries:
+            return False
+        key = _wt_key(ip)
+        n = min(len(entries), self.max_rules)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        rid = np.fromiter((e[0] for e in entries), np.int32, count=len(entries))
+        hits = np.fromiter((e[1] for e in entries), np.int32, count=len(entries))
+        ss = np.fromiter((e[2] for e in entries), np.int64, count=len(entries))
+        sns = np.fromiter((e[3] for e in entries), np.int64, count=len(entries))
+        rc = self._lib.wt_put(
+            base, key, len(key), now_ns, self.expiry_ns,
+            rid.ctypes.data_as(i32p), hits.ctypes.data_as(i32p),
+            ss.ctypes.data_as(i64p), sns.ctypes.data_as(i64p), n,
+        )
+        return rc == 0
+
+    def _read(self, ip: str, fn) -> Optional[WarmEntries]:
+        base = self._base_ptr
+        if base is None:
+            return None
+        key = _wt_key(ip)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        n = int(fn(
+            base, key, len(key),
+            self._rid.ctypes.data_as(i32p), self._hits.ctypes.data_as(i32p),
+            self._ss.ctypes.data_as(i64p), self._sns.ctypes.data_as(i64p),
+        ))
+        if n < 0:
+            return None
+        return [
+            (int(self._rid[k]), int(self._hits[k]),
+             int(self._ss[k]), int(self._sns[k]))
+            for k in range(n)
+        ]
+
+    def take(self, ip: str) -> Optional[WarmEntries]:
+        """Refill read: the record is deleted (move semantics — the state
+        now lives in the hot tier's shadow again)."""
+        return self._read(ip, self._lib.wt_take)
+
+    def peek(self, ip: str) -> Optional[WarmEntries]:
+        """Non-deleting read for introspection (get/format_states)."""
+        return self._read(ip, self._lib.wt_get)
+
+    def contains_batch(self, ips) -> np.ndarray:
+        """bool [n] membership over a distinct-ip list — one C call."""
+        n = len(ips)
+        out = np.zeros(n, dtype=np.uint8)
+        base = self._base_ptr
+        if n == 0 or base is None:
+            return out.astype(bool)
+        from banjax_tpu.native.slotmgr import _encode_ips
+
+        blob, offs, lens = _encode_ips([ip if ip else "\x00" for ip in ips])
+        buf = np.frombuffer(blob, dtype=np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        self._lib.wt_contains_batch(
+            base, buf.ctypes.data_as(u8p), offs.ctypes.data_as(i64p),
+            lens.ctypes.data_as(i64p), n, out.ctypes.data_as(u8p),
+        )
+        return out.astype(bool)
+
+    def __contains__(self, ip: str) -> bool:
+        return bool(self.contains_batch([ip])[0])
+
+    def keys(self) -> List[str]:
+        base = self._base_ptr
+        if base is None:
+            return []
+        cap = self.capacity
+        blob = ctypes.create_string_buffer(cap * WT_KEY_MAX)
+        key_lens = np.zeros(cap, dtype=np.int32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        n = int(self._lib.wt_snapshot_keys(
+            base, blob, key_lens.ctypes.data_as(i32p), cap
+        ))
+        out = []
+        for i in range(n):
+            raw = blob.raw[i * WT_KEY_MAX : i * WT_KEY_MAX + int(key_lens[i])]
+            if raw == b"\x00":
+                raw = b""
+            out.append(raw.decode("utf-8", "surrogatepass"))
+        return out
+
+    def __len__(self) -> int:
+        base = self._base_ptr
+        return int(self._lib.wt_len(base)) if base is not None else 0
+
+    @property
+    def dropped(self) -> int:
+        base = self._base_ptr
+        return int(self._lib.wt_dropped(base)) if base is not None else 0
+
+    def clear(self) -> None:
+        base = self._base_ptr
+        if base is not None:
+            self._lib.wt_clear(base)
+
+    def close(self) -> None:
+        self._base_ptr = None
+        self._shm.close()
+
+    def unlink(self) -> None:
+        if self.owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+
+class PyWarmTier:
+    """Pure-Python warm tier (no C compiler): bounded OrderedDict with
+    the same steal-iff-expired overflow policy, evaluated globally — the
+    stalest record overall is the steal candidate, so this path drops at
+    most as often as the probe-window-bounded C table."""
+
+    def __init__(
+        self,
+        capacity: int = 1 << 20,
+        max_rules: int = 16,
+        expiry_ns: int = 300 * 1_000_000_000,
+    ):
+        cap = 1
+        while cap < max(2, capacity):
+            cap *= 2
+        self.capacity = cap
+        self.max_rules = max(1, int(max_rules))
+        self.expiry_ns = int(expiry_ns)
+        self._dropped = 0
+        # ip -> (stamp_ns, entries); order = last-touch (stalest first)
+        from collections import OrderedDict
+
+        self._d: "OrderedDict[str, Tuple[int, WarmEntries]]" = OrderedDict()
+
+    def put(self, ip: str, entries: WarmEntries, now_ns: int) -> bool:
+        if not entries:
+            return False
+        entries = entries[: self.max_rules]
+        if ip in self._d:
+            self._d[ip] = (now_ns, entries)
+            self._d.move_to_end(ip)
+            return True
+        if len(self._d) >= self.capacity:
+            stale_ip, (stamp, _) = next(iter(self._d.items()))
+            if now_ns - stamp > self.expiry_ns:
+                del self._d[stale_ip]
+                self._dropped += 1
+            else:
+                self._dropped += 1
+                return False
+        self._d[ip] = (now_ns, entries)
+        return True
+
+    def take(self, ip: str) -> Optional[WarmEntries]:
+        v = self._d.pop(ip, None)
+        return None if v is None else v[1]
+
+    def peek(self, ip: str) -> Optional[WarmEntries]:
+        v = self._d.get(ip)
+        return None if v is None else v[1]
+
+    def contains_batch(self, ips) -> np.ndarray:
+        d = self._d
+        return np.fromiter((ip in d for ip in ips), bool, count=len(ips))
+
+    def __contains__(self, ip: str) -> bool:
+        return ip in self._d
+
+    def keys(self) -> List[str]:
+        return list(self._d)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def clear(self) -> None:
+        self._d.clear()
+        self._dropped = 0
+
+    def close(self) -> None:
+        self._d.clear()
+
+    def unlink(self) -> None:
+        pass
+
+
+def create_warm_tier(
+    capacity: int = 1 << 20,
+    max_rules: int = 16,
+    expiry_ns: int = 300 * 1_000_000_000,
+):
+    """A warm tier: the shm-backed C table when the native library is
+    available, else the Python fallback — same interface either way."""
+    if available():
+        try:
+            return ShmWarmTier(
+                capacity=capacity, max_rules=max_rules, expiry_ns=expiry_ns
+            )
+        except Exception:  # noqa: BLE001 — shm creation can fail (rlimits)
+            log.exception("shm warm tier unavailable; Python fallback")
+    return PyWarmTier(
+        capacity=capacity, max_rules=max_rules, expiry_ns=expiry_ns
+    )

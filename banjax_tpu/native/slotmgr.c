@@ -259,6 +259,38 @@ int64_t sm_lookup_batch(void *h, const uint8_t *blob, const int64_t *offs,
     return n_miss;
 }
 
+/* Read-only membership probe over a distinct-ip blob: like pass 1 but
+ * WITHOUT the recency stamp — the admission gate must not refresh an
+ * IP's LRU position just for asking whether it is resident (a refused
+ * batch would otherwise keep every probe victim warm).  Writes 0/1 per
+ * ip; returns the number present. */
+int64_t sm_contains_batch(void *h, const uint8_t *blob, const int64_t *offs,
+                          const int64_t *lens, int64_t n, uint8_t *out) {
+    sm_t *sm = h;
+    uint64_t mask = (uint64_t)sm->table_cap - 1;
+    int64_t found = 0;
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *p = blob + offs[i];
+        int64_t len = lens[i];
+        uint64_t s = sm_hash(p, len) & mask;
+        uint8_t hit = 0;
+        for (;;) {
+            int64_t v = sm->table[s];
+            if (v == -1)
+                break;
+            if (v >= 0 && sm->ip_len[v] == (int32_t)len &&
+                memcmp(sm->ip[v], p, (size_t)len) == 0) {
+                hit = 1;
+                break;
+            }
+            s = (s + 1) & mask;
+        }
+        out[i] = hit;
+        found += hit;
+    }
+    return found;
+}
+
 typedef struct {
     int64_t lu;
     int32_t slot;

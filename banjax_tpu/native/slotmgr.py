@@ -106,6 +106,10 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, _u8p, _i64p, _i64p, ctypes.c_int64,
             _i32p, _i64p, _i32p, _i64p, ctypes.c_int64, _i64p, _i64p,
         ]
+        lib.sm_contains_batch.restype = ctypes.c_int64
+        lib.sm_contains_batch.argtypes = [
+            ctypes.c_void_p, _u8p, _i64p, _i64p, ctypes.c_int64, _u8p,
+        ]
         _LIB = lib
         log.info("native slotmgr loaded (%s)", so)
         return _LIB
@@ -220,6 +224,24 @@ class SlotManager:
             _P(evict, _i64p), _P(counts, _i64p),
         ))
         return miss_idx[: int(counts[1])], evict[: int(counts[0])], rc == 0
+
+    def contains_batch(self, ips: Sequence[str]) -> np.ndarray:
+        """bool [n] membership over a DISTINCT ip list, with NO recency
+        stamp — the slot-admission gate's hot-tier check (a refused
+        batch must not refresh its probe victims' LRU position)."""
+        n = len(ips)
+        out = np.zeros(n, dtype=np.uint8)
+        if n == 0:
+            return out.astype(bool)
+        blob, offs, lens = _encode_ips(ips)
+        buf = np.frombuffer(blob, dtype=np.uint8) if blob else np.zeros(
+            1, dtype=np.uint8
+        )
+        self._lib.sm_contains_batch(
+            self._h, _P(buf, _u8p), _P(offs, _i64p), _P(lens, _i64p), n,
+            _P(out, _u8p),
+        )
+        return out.astype(bool)
 
 
 def create(capacity: int) -> Optional[SlotManager]:

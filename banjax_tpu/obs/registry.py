@@ -125,6 +125,36 @@ FAMILIES: List[Family] = [
     Family(GAUGE, "IPs with live window counters (evicted/spilled included)",
            line_key="DeviceWindowsShadowedIps",
            prom="banjax_device_windows_shadowed_ips"),
+    # ---- mega-state tiering (README "Mega-state tiering") ----
+    Family(COUNTER, "rows refused a device window slot by the sketch "
+           "admission gate (matched and rate-limited statelessly on the "
+           "host path — counted, never dropped)",
+           line_key="SlotRefusals", prom="banjax_slot_refusals_total"),
+    Family(COUNTER, "unseen IPs admitted to a slot because the count-min "
+           "estimate reached the admission threshold",
+           line_key="SketchAdmissions",
+           prom="banjax_sketch_admissions_total"),
+    Family(GAUGE, "fraction of sketch-admitted slots whose hot tenure "
+           "ended with no window state (wasted admissions = collision "
+           "noise; sizes traffic_sketch_width)",
+           line_key="SketchAdmissionFpRate",
+           prom="banjax_sketch_admission_fp_rate"),
+    Family(COUNTER, "evicted slot window vectors spilled into the warm "
+           "tier (native/shmstate.c)",
+           line_key="WarmTierSpills", prom="banjax_warm_tier_spills_total"),
+    Family(COUNTER, "warm-tier entries refilled into a device slot on "
+           "re-admission",
+           line_key="WarmTierRefills",
+           prom="banjax_warm_tier_refills_total"),
+    Family(COUNTER, "spills the warm tier refused (full of unexpired "
+           "entries; state falls back losslessly to the host shadow — "
+           "the raise-warm_tier_capacity signal)",
+           line_key="WarmTierDropped",
+           prom="banjax_warm_tier_dropped_total"),
+    Family(GAUGE, "warm-tier entries occupied",
+           line_key="WarmTierOccupancy", prom="banjax_warm_tier_occupancy"),
+    Family(GAUGE, "warm-tier entry capacity",
+           line_key="WarmTierCapacity", prom="banjax_warm_tier_capacity"),
     # ---- mesh ----
     Family(COUNTER, "sharded-mesh batches served by the fused two-stage path",
            line_key="MeshFusedBatches", prom="banjax_mesh_fused_batches_total"),

@@ -174,6 +174,20 @@ class TrafficSketch:
         # per-rule pressure: host-side exact counts of fired (line, rule)
         # window events (note_rule_events, fed from the Banner replay)
         self._rule_hits = np.zeros(self._n_rules, dtype=np.int64)
+        # HOST count-min mirror for slot-REFUSED rows: a refused row has
+        # no slot, so it never reaches the device update — its count
+        # accrues here (fold_refused) in the same bucket geometry.  An
+        # unseen IP's own rows therefore land EXACTLY in this array, and
+        # the device sketch contributes only collisions, so
+        # estimate_ips >= the IP's true refused-row count no matter how
+        # stale the cached device pull is — the conservatism the
+        # admission gate's bounded-delay argument needs.
+        self._cm_host = np.zeros((self.depth, self.width), dtype=np.int64)
+        self.refused_rows_folded = 0
+        # last-pulled device count-min (host copy): estimate_ips reads
+        # this CACHE — the admission gate runs per batch and must never
+        # force a d2h pull
+        self._cm_cache: Optional[np.ndarray] = None
         # slot → ip-hash table: device copy gathered by the update op
         # (the per-row hashes are already on device once a slot is warm),
         # host mirror diffed per batch so only CHANGED slots scatter up
@@ -368,6 +382,7 @@ class TrafficSketch:
             finally:
                 trace.end(sp)
             rule_hits = self._rule_hits  # host-side, no pull needed
+            self._cm_cache = cm  # refresh the admission gate's cache
             self.pull_bytes_total += cm.nbytes + hll.nbytes
             self.pull_count += 1
             self._last_pull_mono = time.monotonic()
@@ -382,7 +397,10 @@ class TrafficSketch:
                 for j in range(self.depth):
                     col = _fmix32_np(base ^ np.uint32(_CM_SEEDS[j])) \
                         % np.uint32(self.width)
-                    vals = cm[j, col.astype(np.int64)]
+                    ci = col.astype(np.int64)
+                    # device buckets + the refused-row host mirror: the
+                    # estimate covers ALL of an IP's rows, slotted or not
+                    vals = cm[j, ci] + self._cm_host[j, ci]
                     est = vals if est is None else np.minimum(est, vals)
                 for k in heapq.nlargest(
                     self.topk, range(len(ips)), key=lambda i: int(est[i])
@@ -435,6 +453,7 @@ class TrafficSketch:
         del summary
         with self._lock:
             cm = np.asarray(self._state[0]).reshape(self.depth, self.width)
+            cm_host = self._cm_host
         base = np.uint32(hash_ip(ip))
         est = None
         for j in range(self.depth):
@@ -442,9 +461,76 @@ class TrafficSketch:
                 _fmix32_np(np.asarray([base ^ np.uint32(_CM_SEEDS[j])],
                                       dtype=np.uint32))[0]
             ) % self.width
-            v = int(cm[j, col])
+            v = int(cm[j, col]) + int(cm_host[j, col])
             est = v if est is None else min(est, v)
         return int(est or 0)
+
+    # ---- the cold-tier admission surface (mega-state tiering) ----
+
+    @staticmethod
+    def base_hashes(ips: Sequence[str]) -> np.ndarray:
+        """uint32 [n] base hashes for a distinct-ip list — computed once
+        per batch by the runner and shared between estimate_ips and
+        fold_refused (the crc32 walk is the per-unseen-ip host cost)."""
+        return np.fromiter(
+            (hash_ip(ip) for ip in ips), dtype=np.uint32, count=len(ips)
+        )
+
+    def _columns(self, base: np.ndarray) -> np.ndarray:
+        """int64 [depth, n] count-min column per row for base hashes."""
+        cols = np.empty((self.depth, len(base)), dtype=np.int64)
+        for j in range(self.depth):
+            cols[j] = (
+                _fmix32_np(base ^ np.uint32(_CM_SEEDS[j]))
+                % np.uint32(self.width)
+            ).astype(np.int64)
+        return cols
+
+    def estimate_ips(
+        self, ips: Sequence[str], hashes: Optional[np.ndarray] = None
+    ) -> np.ndarray:
+        """Conservative count estimates, int64 [n], from the CACHED
+        last-pulled device count-min plus the exact refused-row host
+        mirror.  Never forces a pull — this runs in the admission gate,
+        once per batch.  An unseen IP's own rows are all in the host
+        mirror (fold_refused), so staleness of the device cache can only
+        UNDER-estimate collision noise, never the IP's true count."""
+        n = len(ips)
+        if n == 0:
+            return np.zeros(0, dtype=np.int64)
+        base = self.base_hashes(ips) if hashes is None else hashes
+        cols = self._columns(base)
+        with self._lock:
+            cache = self._cm_cache
+            est: Optional[np.ndarray] = None
+            for j in range(self.depth):
+                vals = self._cm_host[j, cols[j]]
+                if cache is not None:
+                    vals = vals + cache[j, cols[j]]
+                est = vals if est is None else np.minimum(est, vals)
+        return est
+
+    def fold_refused(
+        self,
+        ips: Sequence[str],
+        counts: np.ndarray,
+        hashes: Optional[np.ndarray] = None,
+    ) -> None:
+        """Fold one batch's REFUSED rows into the host count-min mirror:
+        `counts[i]` rows for distinct ip `ips[i]`.  Exact (int64 adds,
+        no sampling) — these rows never reach the device sketch, and the
+        admission gate's bounded-delay argument needs every one of them
+        counted."""
+        n = len(ips)
+        if n == 0:
+            return
+        base = self.base_hashes(ips) if hashes is None else hashes
+        cols = self._columns(base)
+        counts = np.asarray(counts, dtype=np.int64)
+        with self._lock:
+            for j in range(self.depth):
+                np.add.at(self._cm_host[j], cols[j], counts)
+            self.refused_rows_folded += int(counts.sum())
 
     def incident_snapshot(self) -> dict:
         """The flight-recorder view (`traffic.json`): a FORCED pull so

@@ -121,6 +121,23 @@ class MatcherStats:
             # shadowed IPs = all IPs with live counters (evicted included —
             # spill keeps them; see matcher/windows.py)
             out["DeviceWindowsShadowedIps"] = len(device_windows)
+            # mega-state tiering: admission-gate and warm-tier telemetry.
+            # Gate keys emit whenever the windows object carries them (a
+            # zero refusal count under flood IS the signal the gate is
+            # off); warm keys only when a tier is attached, so untiered
+            # deployments keep their exact line schema.
+            if hasattr(device_windows, "slot_refusals"):
+                out["SlotRefusals"] = device_windows.slot_refusals
+                out["SketchAdmissions"] = device_windows.sketch_admissions
+                out["SketchAdmissionFpRate"] = round(
+                    device_windows.sketch_admission_fp_rate, 4
+                )
+            if getattr(device_windows, "_warm", None) is not None:
+                out["WarmTierSpills"] = device_windows.warm_spills
+                out["WarmTierRefills"] = device_windows.warm_refills
+                out["WarmTierDropped"] = device_windows.warm_dropped
+                out["WarmTierOccupancy"] = device_windows.warm_occupancy
+                out["WarmTierCapacity"] = device_windows.warm_capacity
         if matcher is not None:
             mm = getattr(matcher, "_mesh_matcher", None)
             if mm is not None:

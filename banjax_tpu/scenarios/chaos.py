@@ -11,9 +11,12 @@ the explicit per-episode capture guarantees the evidence floor even for
 faults the engine absorbs without tripping anything.
 
 The default point set is every failpoint on the pipeline's driven path;
-tailer-fed runs add `tailer.open` (rotation reopen faults).  kafka.read/
-kafka.send live on reader/writer loops the runner does not spin up —
-their fault coverage stays in tests/faults/test_kafka_faults.py.
+tailer-fed runs add `tailer.open` (rotation reopen faults).  Kafka-fed
+runs (ScenarioRunner's `kafka_broker` mode: commands produced into an
+in-process broker and drained by a REAL KafkaReader/KafkaWriter pair
+over the wire protocol) add `kafka.read`/`kafka.send`, so the
+reconnect-with-backoff and held-report-retry loops take faults during
+soak, not only in tests/faults/test_kafka_faults.py.
 """
 
 from __future__ import annotations
@@ -35,6 +38,7 @@ PIPELINE_POINTS = (
     "matcher.resolve",
 )
 TAILER_POINTS = PIPELINE_POINTS + ("tailer.open",)
+KAFKA_POINTS = PIPELINE_POINTS + ("kafka.read", "kafka.send")
 
 
 @dataclasses.dataclass

@@ -101,7 +101,7 @@ class ScenarioReport:
     name: str
     seed: int
     scale: float
-    mode: str                      # "direct" | "tailer"
+    mode: str                      # "direct" | "tailer" | "kafka"
     single_kernel: str
     n_lines: int
     n_commands: int
@@ -148,6 +148,8 @@ class ScenarioRunner:
         slo_budget_s: float = 2.0,
         slo_sample_every: int = 4,
         breaker_recovery_s: float = 0.5,
+        cfg_overrides: Optional[Dict[str, object]] = None,
+        kafka_broker=None,
     ):
         self.scenario = scenario
         self.single_kernel = single_kernel
@@ -161,7 +163,17 @@ class ScenarioRunner:
         self.slo_budget_s = slo_budget_s
         self.slo_sample_every = max(1, slo_sample_every)
         self.breaker_recovery_s = breaker_recovery_s
+        self.cfg_overrides = cfg_overrides
+        # kafka-fed command mode: an in-process broker (duck-typed:
+        # .port / .append / .log_end_offset — tests/fake_kafka_broker)
+        # receives every CommandBatch and a REAL KafkaReader drains it
+        # over the wire protocol into the pipeline's admission buffer,
+        # with a KafkaWriter pushing one report per batch the other way
+        # — the mode where kafka.read/kafka.send failpoints fire during
+        # soak instead of only in the fault unit tests.
+        self.kafka_broker = kafka_broker
         self._commands_handled = 0
+        self._kafka_reports_sent = 0
 
     # ---- engine assembly ----
 
@@ -176,6 +188,16 @@ class ScenarioRunner:
         cfg.pallas_single_kernel = self.single_kernel
         cfg.breaker_recovery_seconds = self.breaker_recovery_s
         cfg.expiring_decision_ttl_seconds = 300
+        if self.kafka_broker is not None:
+            cfg.kafka_brokers = [f"127.0.0.1:{self.kafka_broker.port}"]
+            cfg.kafka_command_topic = "scenario.commands"
+            cfg.kafka_report_topic = "scenario.reports"
+            cfg.kafka_max_wait_ms = 100
+        if self.cfg_overrides:
+            # harness-level knobs (slot admission, warm tier, ...) the
+            # scenario's rules_yaml doesn't carry
+            for k, v in self.cfg_overrides.items():
+                setattr(cfg, k, v)
         self.cfg = cfg
         self.dynamic_lists = DynamicDecisionLists(start_sweeper=False)
         self.banner = RecordingBanner()
@@ -244,6 +266,106 @@ class ScenarioRunner:
         handle_command(self.cfg, cmd, self.dynamic_lists)
         self._commands_handled += 1
 
+    # ---- kafka-fed command mode ----
+
+    def _kafka_dispatch(self, raw: bytes) -> None:
+        """Reader drain-stage handler: readiness pings settle the tail-
+        attach race (the reader consumes from latest; its attach moment
+        is unobservable), everything else is a scenario command."""
+        if b'"scenario_ping"' in raw:
+            self._kafka_ready.set()
+            return
+        self._handle_command(raw)
+
+    def _kafka_start(self) -> dict:
+        import queue as queue_mod
+        import threading
+
+        from banjax_tpu.ingest import reports
+        from banjax_tpu.ingest.kafka_io import KafkaReader, KafkaWriter
+        from banjax_tpu.ingest.kafka_wire import WireKafkaTransport
+        from banjax_tpu.resilience.backoff import Backoff
+
+        class _Holder:
+            def __init__(self, cfg):
+                self._cfg = cfg
+
+            def get(self):
+                return self._cfg
+
+        # other tests share the module-level report queue: drain it so
+        # the produced-report settle counts only this run's reports
+        q = reports.get_message_queue()
+        while True:
+            try:
+                q.get_nowait()
+            except queue_mod.Empty:
+                break
+
+        self._kafka_ready = threading.Event()
+        holder = _Holder(self.cfg)
+        fast = dict(base=0.05, cap=0.2, jitter=0.0)
+        reader = KafkaReader(
+            holder, self.dynamic_lists, transport=WireKafkaTransport(),
+            backoff=Backoff(**fast), pipeline=self.sched,
+        )
+        reader.dispatch_raw = self._kafka_dispatch
+        writer = KafkaWriter(
+            holder, transport=WireKafkaTransport(), backoff=Backoff(**fast)
+        )
+        reader.start()
+        writer.start()
+        # the reader attaches at the log tail at an unobservable moment:
+        # keep producing pings until one round-trips through the real
+        # fetch path + pipeline drain (no fixed sleeps)
+        deadline = time.monotonic() + 30
+        while not self._kafka_ready.wait(0.05):
+            if time.monotonic() > deadline:
+                raise RuntimeError("kafka scenario reader never attached")
+            self.kafka_broker.append(
+                self.cfg.kafka_command_topic, 0, b'{"Name": "scenario_ping"}'
+            )
+        return {"reader": reader, "writer": writer, "queue": q}
+
+    def _kafka_feed(self, ev: CommandBatch, ctx: dict) -> None:
+        """One CommandBatch: produce every raw into the broker's command
+        topic (the reader's fetch loop delivers them into the pipeline)
+        and push one report the other way through the writer, so BOTH
+        kafka failpoints sit on exercised code during the soak."""
+        for raw in ev.raws:
+            self.kafka_broker.append(self.cfg.kafka_command_topic, 0, raw)
+        ctx["queue"].put_nowait(
+            json.dumps({"name": "scenario_report",
+                        "batch": self._kafka_reports_sent}).encode()
+        )
+        self._kafka_reports_sent += 1
+
+    def _kafka_settle(self, n_cmds: int) -> None:
+        """Wait for the async kafka legs to finish: every command drained
+        (clean runs — a kafka.read episode loses the tail-attach window
+        by design, exactly the reference's consume-from-latest contract)
+        and every report produced (the writer never drops a dequeued
+        report, so this converges even across kafka.send faults)."""
+        deadline = time.monotonic() + 60
+        topic = self.cfg.kafka_report_topic
+        while time.monotonic() < deadline:
+            self.sched.flush(60)
+            cmds_ok = self._commands_handled >= n_cmds
+            reports_ok = (
+                self.kafka_broker.log_end_offset(topic, 0)
+                >= self._kafka_reports_sent
+            )
+            if cmds_ok and reports_ok:
+                return
+            time.sleep(0.05)
+        if self.chaos is None:
+            raise RuntimeError(
+                f"kafka scenario did not settle: "
+                f"{self._commands_handled}/{n_cmds} commands, "
+                f"{self.kafka_broker.log_end_offset(topic, 0)}"
+                f"/{self._kafka_reports_sent} reports"
+            )
+
     # ---- the run ----
 
     def run(self) -> ScenarioReport:
@@ -280,6 +402,9 @@ class ScenarioRunner:
         sc = self.scenario
         self.sched.start()
         tailer_ctx = self._tailer_start() if self.via_tailer else None
+        kafka_ctx = (
+            self._kafka_start() if self.kafka_broker is not None else None
+        )
         try:
             self._warmup()
 
@@ -300,9 +425,12 @@ class ScenarioRunner:
                     else:
                         self.sched.submit(list(ev.lines))
                 elif isinstance(ev, CommandBatch):
-                    self.sched.submit_commands(
-                        list(ev.raws), self._handle_command
-                    )
+                    if kafka_ctx is not None:
+                        self._kafka_feed(ev, kafka_ctx)
+                    else:
+                        self.sched.submit_commands(
+                            list(ev.raws), self._handle_command
+                        )
                 elif isinstance(ev, Rotation):
                     if tailer_ctx is not None:
                         self._tailer_rotate(tailer_ctx)
@@ -314,6 +442,8 @@ class ScenarioRunner:
                     int(base["PipelineAdmittedLines"])
                     + len(sc.lines()) + sc.n_commands(),
                 )
+            if kafka_ctx is not None:
+                self._kafka_settle(sc.n_commands())
             if not self.sched.flush(600):
                 raise RuntimeError(f"scenario {sc.name} did not drain")
             feed_s = max(1e-9, time.perf_counter() - t_feed)
@@ -321,6 +451,9 @@ class ScenarioRunner:
             if self.chaos is not None:
                 self.chaos.finish()
         finally:
+            if kafka_ctx is not None:
+                kafka_ctx["reader"].stop()
+                kafka_ctx["writer"].stop()
             if tailer_ctx is not None:
                 tailer_ctx["tailer"].stop()
                 tailer_ctx["writer"].close()
@@ -442,7 +575,10 @@ class ScenarioRunner:
             name=sc.name,
             seed=sc.seed,
             scale=sc.scale,
-            mode="tailer" if self.via_tailer else "direct",
+            mode=(
+                "tailer" if self.via_tailer
+                else "kafka" if self.kafka_broker is not None else "direct"
+            ),
             single_kernel=self.single_kernel,
             n_lines=n_lines,
             n_commands=n_cmds,

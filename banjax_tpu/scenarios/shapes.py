@@ -336,6 +336,91 @@ def benign(seed: int, scale: float = 1.0) -> Scenario:
                      notes={"lines": n})
 
 
+# ------------------------------------------- mega-state streaming shape
+
+# the mega noise paths are RULE-NEUTRAL by construction: a slot-REFUSED
+# row that matches a rule still accrues host window state (the
+# bounded-ban-delay contract), so 10M matching noise IPs would grow host
+# state without bound — neutral paths keep refused noise stateless while
+# slot churn (the thing the A/B measures) is match-independent anyway
+_MEGA_NOISE_PATHS = ("/about", "/contact", "/robots.txt", "/news/2026/07")
+MEGA_OFFENDER_HITS = 50  # > http_flood's 40/5s inside a 2 s slice
+
+
+def _mega_offender_timed(
+    seed: int, n_repeat: int = 3
+) -> List[Tuple[float, str]]:
+    """The repeat offenders hidden in the mega rotation, sorted by time.
+    Shared verbatim by the stream generator and the oracle scenario so
+    the offender sub-stream is byte-identical in both."""
+    rng = random.Random(seed)
+    timed = []
+    for k in range(n_repeat):
+        ip = f"203.0.113.{k + 1}"  # TEST-NET-3: never collides with noise
+        for _ in range(MEGA_OFFENDER_HITS):
+            t = T0 + 3.0 + rng.uniform(0.0, 2.0)
+            timed.append((t, _line(t, ip, "GET", _HOSTS[0], "/home",
+                                   "curl/8.1")))
+    timed.sort(key=lambda p: p[0])
+    return timed
+
+
+def mega_offenders(seed: int, n_repeat: int = 3) -> Scenario:
+    """Offender-only mini Scenario: the oracle input for mega runs.
+
+    The mega noise is rule-neutral, so the full stream's expected ban
+    multiset equals `oracle.expected_bans` over just the offenders —
+    per-(ip, rule) fixed windows make the noise interleaving irrelevant.
+    Each offender lands exactly one http_flood ban (hit 41 exceeds 40
+    and resets to 0; the remaining 9 hits cannot re-fire)."""
+    timed = list(_mega_offender_timed(seed, n_repeat))
+    return _scenario(
+        "mega_rotating_proxies", seed, float(n_repeat), _chunked(timed),
+        notes={"repeat_offenders": n_repeat,
+               "hits_per_offender": MEGA_OFFENDER_HITS},
+    )
+
+
+def mega_rotating_proxies_stream(seed: int, n_distinct: int,
+                                 n_repeat: int = 3, chunk: int = 16384):
+    """rotating_proxies at mega scale: a GENERATOR of line chunks, never
+    materializing the stream — 10M+ distinct IPs in bounded memory (one
+    chunk of strings plus the 150-line offender list).
+
+    Noise: the k-th of `n_distinct` IPs fires exactly one rule-neutral
+    request at t = T0 + SPAN_S*k/n_distinct (evenly spaced, so the
+    stream is time-sorted by construction and pure in (seed, n_distinct)
+    — `seed` jitters only the offender sub-stream).  Offenders: the same
+    `_mega_offender_timed` lines the oracle scenario uses, merged in
+    timestamp order.  Chunks are `chunk` lines (device-batch shaped, not
+    tailer-shaped: this stream exists to drive consume_lines directly)."""
+    offenders = _mega_offender_timed(seed, n_repeat)
+    oi, on = 0, len(offenders)
+    buf: List[str] = []
+    for k in range(n_distinct):
+        t = T0 + SPAN_S * k / n_distinct
+        while oi < on and offenders[oi][0] <= t:
+            buf.append(offenders[oi][1])
+            oi += 1
+            if len(buf) >= chunk:
+                yield buf
+                buf = []
+        ip = (f"{10 + (k >> 24)}.{(k >> 16) & 0xFF}."
+              f"{(k >> 8) & 0xFF}.{k & 0xFF}")
+        buf.append(_line(t, ip, "GET", _HOSTS[k % len(_HOSTS)],
+                         _MEGA_NOISE_PATHS[k & 3],
+                         _BENIGN_UAS[(k >> 2) & 3]))
+        if len(buf) >= chunk:
+            yield buf
+            buf = []
+    buf.extend(ln for _, ln in offenders[oi:])
+    while len(buf) >= chunk:
+        yield buf[:chunk]
+        buf = buf[chunk:]
+    if buf:
+        yield buf
+
+
 SHAPES: Dict[str, Callable[..., Scenario]] = {
     "flash_crowd": flash_crowd,
     "slow_drip": slow_drip,

@@ -90,6 +90,17 @@ ban precision/recall vs the generator's oracle and SLO burn peaks,
 plus a seeded chaos-soak row with per-failpoint-episode evidence —
 banked into BENCH_scenarios.json.  Knobs: BENCH_SCEN_{SCALE,SEED},
 BENCH_CPU=1.
+
+Mega-state mode: `bench.py --mega-state` — the mega-state tiering A/B
+(README "Mega-state tiering"): the streaming 10M-distinct-IP rotation
+(scenarios/shapes.py mega_rotating_proxies_stream) driven through
+consume_lines with the slot-admission gate OFF then ON, same stream,
+slot capacity pinned at the 65k worst-case shape.  Banks both rows —
+lines/s, ban precision/recall vs the offender-only oracle, slot
+refusals, sketch admissions + FP rate, warm-tier spill/refill — into
+BENCH_mega_state.json.  Acceptance (ISSUE 14): p/r 1.0 both rows and
+the admission-on row's lines/s >= the admission-off row's.  Knobs:
+BENCH_MEGA_{DISTINCT,CHUNK,SEED,CAPACITY,SKETCH_WIDTH}, BENCH_CPU=1.
 """
 
 from __future__ import annotations
@@ -1657,6 +1668,155 @@ def _scenarios_mode() -> None:
     print(json.dumps({"metric": book["metric"], **book["summary"]}))
 
 
+MEGA_STATE_PATH = os.path.join(_DIR, "BENCH_mega_state.json")
+
+
+def _mega_state_mode() -> None:
+    """`bench.py --mega-state`: the mega-state tiering A/B.
+
+    One streamed pass of the 10M-distinct rotation per arm (admission
+    off, then on — same generator args, so byte-identical streams),
+    slot capacity pinned at 65536 (the ISSUE 14 worst-case shape) so
+    the OFF arm actually pays the all-distinct slot churn the gate
+    exists to remove.  Both arms run the warm tier and a sketch wide
+    enough that the refused-fold mass (one row per distinct IP) keeps
+    conservative estimates under the derived admission threshold —
+    width is a knob so the banked row records the sizing that held.
+    """
+    import jax
+
+    if os.environ.get("BENCH_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from banjax_tpu.scenarios import oracle as oracle_mod
+    from banjax_tpu.scenarios.runtime import RecordingBanner
+    from banjax_tpu.scenarios.shapes import (
+        RULES_YAML,
+        RUN_NOW,
+        mega_offenders,
+        mega_rotating_proxies_stream,
+    )
+
+    backend = jax.devices()[0].platform
+    n_distinct = int(os.environ.get("BENCH_MEGA_DISTINCT", "10000000"))
+    chunk = int(os.environ.get("BENCH_MEGA_CHUNK", "16384"))
+    seed = int(os.environ.get("BENCH_MEGA_SEED", "20260804"))
+    capacity = int(os.environ.get("BENCH_MEGA_CAPACITY", "65536"))
+    sketch_width = int(
+        os.environ.get("BENCH_MEGA_SKETCH_WIDTH", str(1 << 22))
+    )
+
+    def build(admission: bool):
+        cfg = config_from_yaml_text(RULES_YAML)
+        cfg.matcher = "tpu"
+        cfg.matcher_device_windows = True
+        cfg.matcher_batch_lines = chunk
+        cfg.matcher_window_capacity = capacity
+        cfg.traffic_sketch_enabled = True
+        cfg.traffic_sketch_width = sketch_width
+        cfg.slot_admission_enabled = admission
+        cfg.warm_tier_enabled = True
+        banner = RecordingBanner()
+        matcher = TpuMatcher(
+            cfg, banner, StaticDecisionLists(cfg), RegexRateLimitStates()
+        )
+        return cfg, matcher, banner
+
+    # the oracle: offenders only — the mega noise is rule-neutral by
+    # construction, so per-(ip, rule) fixed windows make the full
+    # stream's expected multiset equal the offender sub-stream's
+    oracle_cfg = config_from_yaml_text(RULES_YAML)
+    oracle_bans = oracle_mod.expected_bans(
+        mega_offenders(seed), oracle_cfg
+    )
+
+    rows = {}
+    for arm in ("admission_off", "admission_on"):
+        admission = arm == "admission_on"
+        cfg, matcher, banner = build(admission)
+        n_lines = 0
+        t0 = time.perf_counter()
+        for lines in mega_rotating_proxies_stream(
+            seed, n_distinct, chunk=chunk
+        ):
+            matcher.consume_lines(lines, now_unix=RUN_NOW)
+            n_lines += len(lines)
+        elapsed = time.perf_counter() - t0
+        dw = matcher.device_windows
+        precision, recall, _ = oracle_mod.precision_recall(
+            banner.regex_ban_logs, oracle_bans
+        )
+        rows[arm] = {
+            "lines": n_lines,
+            "distinct_ips": n_distinct,
+            "elapsed_s": round(elapsed, 3),
+            "lines_per_sec": round(n_lines / elapsed, 1),
+            "engine_bans": len(banner.regex_ban_logs),
+            "oracle_bans": len(oracle_bans),
+            "precision": precision,
+            "recall": recall,
+            "slot_refusals": dw.slot_refusals,
+            "sketch_admissions": dw.sketch_admissions,
+            "sketch_admission_fp_rate": round(
+                dw.sketch_admission_fp_rate, 6
+            ),
+            "slot_occupancy": dw.occupancy,
+            "slot_capacity": capacity,
+            "warm_spills": dw.warm_spills,
+            "warm_refills": dw.warm_refills,
+            "warm_dropped": dw.warm_dropped,
+            "warm_occupancy": dw.warm_occupancy,
+        }
+        matcher.close()
+        print(json.dumps({"arm": arm, **rows[arm]}), flush=True)
+
+    on, off = rows["admission_on"], rows["admission_off"]
+    book = {
+        "metric": (
+            "mega-state tiering: sketch-gated slot admission A/B at "
+            f"{n_distinct} distinct IPs"
+        ),
+        "backend": backend,
+        "seed": seed,
+        "chunk_lines": chunk,
+        "sketch_width": sketch_width,
+        "sketch_depth": int(oracle_cfg.traffic_sketch_depth),
+        "admission_min_estimate_derived": (
+            min(
+                r.hits_per_interval
+                for r in oracle_cfg.regexes_with_rates
+            )
+            + 1
+        ),
+        "measured_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "rows": rows,
+        "summary": {
+            "speedup_on_vs_off": round(
+                on["lines_per_sec"] / off["lines_per_sec"], 4
+            ),
+            "acceptance_on_not_slower": (
+                on["lines_per_sec"] >= off["lines_per_sec"]
+            ),
+            "acceptance_ban_parity": all(
+                r[k] == 1.0
+                for r in (on, off)
+                for k in ("precision", "recall")
+            ),
+        },
+    }
+    tmp = MEGA_STATE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(book, f, indent=1)
+    os.replace(tmp, MEGA_STATE_PATH)
+    print(json.dumps({"metric": book["metric"], **book["summary"]}))
+
+
 def _single_kernel_mode() -> None:
     """`bench.py --single-kernel`: the streaming pipeline + device
     windows with the single-kernel fused program ON (one dispatch, one
@@ -2070,6 +2230,9 @@ def main() -> None:
         return
     if "--single-kernel" in sys.argv:
         _single_kernel_mode()
+        return
+    if "--mega-state" in sys.argv:
+        _mega_state_mode()
         return
     if "--scenarios" in sys.argv:
         _scenarios_mode()

@@ -19,7 +19,8 @@ import pytest
 
 from banjax_tpu.resilience import failpoints
 from banjax_tpu.scenarios import ChaosSchedule, ScenarioRunner, generate
-from banjax_tpu.scenarios.chaos import TAILER_POINTS
+from banjax_tpu.scenarios.chaos import KAFKA_POINTS, TAILER_POINTS
+from tests.fake_kafka_broker import FakeKafkaBroker
 
 SEED = 20260804  # the committed soak seed: every CI run replays it
 
@@ -75,6 +76,56 @@ def test_command_flood_drains_every_command_in_take_max_batches():
     _assert_invariants(rep)
     assert rep.command_items == rep.n_commands > 0
     assert rep.precision == 1.0 and rep.recall == 1.0
+
+
+def test_command_flood_through_real_kafka_reader():
+    """The PR 9 chaos gap, clean half: command_flood produced into an
+    in-process broker and drained by a REAL KafkaReader over the wire
+    protocol into the pipeline — every command lands, every per-batch
+    report comes back out through the KafkaWriter."""
+    broker = FakeKafkaBroker().start()
+    try:
+        rep = ScenarioRunner(
+            generate("command_flood", SEED, scale=0.3), kafka_broker=broker
+        ).run()
+        _assert_invariants(rep)
+        assert rep.mode == "kafka"
+        assert rep.command_items == rep.n_commands > 0
+        assert rep.precision == 1.0 and rep.recall == 1.0
+        assert broker.log_end_offset("scenario.reports", 0) > 0
+    finally:
+        broker.stop()
+
+
+def test_kafka_chaos_soak_fires_kafka_failpoints(tmp_path):
+    """The PR 9 chaos gap, chaotic half: kafka.read/kafka.send episodes
+    over the kafka-fed command_flood — the reconnect and held-report
+    loops take faults while real traffic flows, invariants hold, every
+    episode leaves a bundle.  Arming only the two kafka points makes
+    the shuffled rotation cover both within the shape's few events
+    (KAFKA_POINTS mixes in the pipeline points for longer soaks)."""
+    sc = generate("command_flood", SEED, scale=0.3)
+    assert set(KAFKA_POINTS) >= {"kafka.read", "kafka.send"}
+    chaos = ChaosSchedule(
+        seed=SEED + 2, n_events=len(sc.events),
+        points=("kafka.read", "kafka.send"),
+        episodes=min(4, len(sc.events) - 1),
+    )
+    broker = FakeKafkaBroker().start()
+    try:
+        rep = ScenarioRunner(
+            sc, chaos=chaos, kafka_broker=broker,
+            flightrec_dir=str(tmp_path / "flightrec"),
+        ).run()
+    finally:
+        broker.stop()
+    _assert_invariants(rep)
+    assert all(ep["bundle"] for ep in rep.episodes)
+    armed_points = {ep["point"] for ep in rep.episodes}
+    assert {"kafka.read", "kafka.send"} <= armed_points
+    # the writer's held-report retry converges: every produced report
+    # reached the broker despite kafka.send faults
+    assert broker.log_end_offset("scenario.reports", 0) > 0
 
 
 def test_short_seeded_chaos_soak(tmp_path):

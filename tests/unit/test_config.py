@@ -232,3 +232,43 @@ def test_failpoints_admin_key_default_and_typing():
 
     with pytest.raises(ValueError, match="failpoints_admin_enabled"):
         config_from_yaml_text('failpoints_admin_enabled: "yes"\n')
+
+
+def test_mega_state_tiering_keys_defaults_and_validation():
+    cfg = config_from_yaml_text("")
+    assert cfg.slot_admission_enabled is False
+    assert cfg.slot_admission_min_estimate == 0
+    assert cfg.warm_tier_enabled is False
+    assert cfg.warm_tier_capacity == 1 << 20
+
+    cfg = config_from_yaml_text(
+        "matcher_device_windows: true\n"
+        "traffic_sketch_enabled: true\n"
+        "slot_admission_enabled: true\n"
+        "slot_admission_min_estimate: 9\n"
+        "warm_tier_enabled: true\n"
+        "warm_tier_capacity: 4096\n"
+    )
+    assert cfg.slot_admission_enabled is True
+    assert cfg.slot_admission_min_estimate == 9
+    assert cfg.warm_tier_enabled is True
+    assert cfg.warm_tier_capacity == 4096
+
+    for bad in (
+        # admission requires both the sketch and device windows
+        "slot_admission_enabled: true",
+        # ... sketch on by default, so it must be REFUSED when off
+        "slot_admission_enabled: true\nmatcher_device_windows: true\n"
+        "traffic_sketch_enabled: false",
+        "slot_admission_enabled: true\ntraffic_sketch_enabled: true",
+        # warm tier requires device windows
+        "warm_tier_enabled: true",
+        "warm_tier_capacity: 0",
+        "warm_tier_capacity: -4",
+        # Go yaml.v2 strictness: wrong-typed values fail the load
+        'slot_admission_enabled: "yes"',
+        'slot_admission_min_estimate: "9"',
+        "warm_tier_capacity: banana",
+    ):
+        with pytest.raises(ValueError):
+            config_from_yaml_text(bad)

@@ -41,8 +41,9 @@ def drive_oracle(rules, batches):
     return states, out
 
 
-def drive_device(rules, batches, capacity=64, max_events=512):
-    dw = DeviceWindows(rules, capacity=capacity, max_events=max_events)
+def drive_device(rules, batches, capacity=64, max_events=512, **kw):
+    dw = DeviceWindows(rules, capacity=capacity, max_events=max_events,
+                       **kw)
     active = np.ones((1, len(rules)), dtype=bool)
     out = []
 
@@ -454,3 +455,114 @@ def test_concurrent_consume_reload_metrics_soak():
         now,
     )[0]
     assert r.exempted
+
+
+# ---------------------------------------------------------------- warm tier
+
+
+def test_warm_tier_round_trip_byte_identical():
+    """Eviction spill into the warm tier and re-admission refill carry
+    the per-rule (num_hits, interval_start) vectors BYTE-identically —
+    the ISSUE 14 lossless-spill contract, asserted on the raw entry
+    tuples, not just on continued-counting behavior."""
+    rules = [make_rule("fast", 5.0, 100), make_rule("slow", 60.0, 100)]
+    dw = DeviceWindows(rules, capacity=2, warm_tier_enabled=True,
+                       warm_tier_capacity=64)
+    assert dw._warm is not None
+    active = np.ones((1, 2), dtype=bool)
+    base = 1_700_000_000 * NS + 123_456_789  # odd ns: both words matter
+
+    def hit(ip, t, bits):
+        slots = dw.slots_for_ips([ip])
+        ts_s, ts_ns = split_ns(np.array([t], dtype=np.int64))
+        return dw.apply_bitmap(
+            np.array([bits], dtype=np.uint8), slots, ts_s, ts_ns,
+            active, np.zeros(1, dtype=np.int32),
+        )
+
+    hit("ip-a", base, [1, 1])
+    hit("ip-a", base + 7, [1, 0])      # fast=2, slow=1, starts at base
+    hit("ip-b", base + 8, [0, 1])
+    snap = {r: (s.num_hits, s.interval_start_time_ns)
+            for r, s in dw.get("ip-a")[0].items()}
+    assert snap == {"fast": (2, base), "slow": (1, base)}
+
+    hit("ip-c", base + 9, [1, 0])      # evicts ip-a -> SPILL to warm
+    assert dw.warm_spills == 1
+    assert dw.warm_occupancy == 1
+    ent = dw._warm.peek("ip-a")
+    assert ent is not None
+    got = {rules[rid].rule: (h, s * NS + ns) for rid, h, s, ns in ent}
+    assert got == snap                  # the raw spilled vectors
+    assert "ip-a" not in dw._shadow     # warm is the home, not a copy
+
+    hit("ip-a", base + 10, [1, 1])     # returns -> REFILL from warm;
+    #                                    its slot claim evicts ip-b,
+    #                                    which spills in turn
+    assert dw.warm_refills == 1
+    assert dw.warm_spills == 2
+    assert dw.warm_occupancy == 1       # take(), not a copy: only ip-b
+    assert dw._warm.peek("ip-a") is None
+    assert dw._warm.peek("ip-b") is not None
+    after = {r: (s.num_hits, s.interval_start_time_ns)
+             for r, s in dw.get("ip-a")[0].items()}
+    assert after == {"fast": (3, base), "slow": (2, base)}
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_warm_tier_churn_differential(seed):
+    """The eviction-churn differential with the warm tier as the spill
+    home: event streams and final per-(ip, rule) state still match the
+    host oracle exactly, and the run actually spilled and refilled."""
+    rules = [make_rule("fast", 5.0, 2), make_rule("slow", 60.0, 4)]
+    rng = np.random.default_rng(seed)
+    batches = random_batches(rng, 2, n_ips=24, n_batches=6, batch=16,
+                             density=0.5)
+    states, want = drive_oracle(rules, batches)
+    dw, got = drive_device(rules, batches, capacity=8,
+                           warm_tier_enabled=True, warm_tier_capacity=64)
+    assert dw.eviction_count > 0
+    assert dw.warm_spills > 0, "churn never spilled into the warm tier"
+    assert dw.warm_refills > 0, "no returning IP ever refilled"
+    assert got == want
+    for i in range(24):
+        ip = f"10.0.0.{i}"
+        host_states, host_ok = states.get(ip)
+        dev_states, dev_ok = dw.get(ip)
+        assert host_ok == dev_ok, ip
+        for rule, s in host_states.items():
+            d = dev_states[rule]
+            assert (s.num_hits, s.interval_start_time_ns) == (
+                d.num_hits, d.interval_start_time_ns
+            ), (ip, rule)
+
+
+def test_warm_tier_drop_keeps_shadow_entry():
+    """When the warm tier cannot place a spill (probe window full of
+    live records), the shadow KEEPS the entry — pre-tiering lossless
+    behavior — and the tier's dropped counter surfaces the pressure."""
+    rules = [make_rule("r", 600.0, 100)]  # wide window: no expiry steals
+    dw = DeviceWindows(rules, capacity=2, warm_tier_enabled=True,
+                       warm_tier_capacity=1)  # tiny tier: drops fast
+    active = np.ones((1, 1), dtype=bool)
+    one = np.ones((1, 1), dtype=np.uint8)
+    base = 1_700_000_000 * NS
+
+    def hit(ip, t):
+        slots = dw.slots_for_ips([ip])
+        ts_s, ts_ns = split_ns(np.array([t], dtype=np.int64))
+        dw.apply_bitmap(one, slots, ts_s, ts_ns, active,
+                        np.zeros(1, dtype=np.int32))
+
+    n = 12
+    for i in range(n):  # constant churn: every placement evicts
+        hit(f"ip-{i}", base + i)
+    spilled_or_kept = 0
+    for i in range(n - 2):  # the last 2 are hot-resident
+        states, ok = dw.get(f"ip-{i}")
+        assert ok and states["r"].num_hits == 1, f"ip-{i} state lost"
+        spilled_or_kept += 1
+    assert spilled_or_kept == n - 2
+    assert dw.warm_dropped > 0, "tiny tier never reported drop pressure"
+    # every dropped spill fell back to the shadow (lossless)
+    assert dw.warm_spills + len(dw._shadow) >= n - 2

@@ -392,3 +392,66 @@ def test_single_kernel_depth_ignored_on_line_and_metrics(loaded_system):
         s[2] for s in fams["banjax_single_kernel_depth_ignored"]["samples"]
     ]
     assert v == 1
+
+
+def test_mega_state_families_render_and_declare():
+    """The ISSUE 14 tiering families: a gated matcher whose unseen IPs
+    all land BELOW the derived admission threshold (the fixture rule
+    needs 101 hits) refuses every slot claim, homes the refused-row
+    window state in the warm tier, and must surface all of it on both
+    exposition surfaces with every name registry-declared."""
+    cfg = config_from_yaml_text(RULES_YAML)
+    cfg.matcher_device_windows = True
+    cfg.matcher_window_capacity = 64
+    cfg.traffic_sketch_enabled = True
+    cfg.slot_admission_enabled = True   # min_estimate 0 -> derived 101
+    cfg.warm_tier_enabled = True
+    cfg.warm_tier_capacity = 1024
+    m = TpuMatcher(cfg, MockBanner(), StaticDecisionLists(cfg),
+                   RegexRateLimitStates())
+    try:
+        now = time.time()
+        m.consume_lines(
+            [f"{now:.6f} 7.7.{i >> 8}.{i & 255} GET h.com GET /x HTTP/1.1"
+             for i in range(48)],
+            now,
+        )
+        dw = m.device_windows
+        assert dw.slot_refusals >= 48      # every unseen IP refused
+        assert dw.warm_spills > 0          # refused state homes warm
+        text = render_prometheus(
+            DynamicDecisionLists(start_sweeper=False),
+            RegexRateLimitStates(), FailedChallengeRateLimitStates(),
+            matcher=m,
+        )
+        fams = parse_text_format(text)
+        undeclared = [f for f in fams if f not in registry.PROM_FAMILIES]
+        assert not undeclared, undeclared
+        scalars = {
+            s[0]: s[2] for ent in fams.values() for s in ent["samples"]
+            if not s[1]
+        }
+        assert scalars["banjax_slot_refusals_total"] >= 48
+        assert scalars["banjax_sketch_admissions_total"] == 0
+        assert scalars["banjax_sketch_admission_fp_rate"] == 0
+        assert scalars["banjax_warm_tier_spills_total"] > 0
+        assert scalars["banjax_warm_tier_refills_total"] == 0
+        assert scalars["banjax_warm_tier_dropped_total"] == 0
+        assert scalars["banjax_warm_tier_occupancy"] > 0
+        assert scalars["banjax_warm_tier_capacity"] == 1024
+        out = io.StringIO()
+        write_metrics_line(
+            out, DynamicDecisionLists(start_sweeper=False),
+            RegexRateLimitStates(), FailedChallengeRateLimitStates(), m,
+        )
+        line = json.loads(out.getvalue())
+        for key in ("SlotRefusals", "SketchAdmissions",
+                    "SketchAdmissionFpRate", "WarmTierSpills",
+                    "WarmTierRefills", "WarmTierDropped",
+                    "WarmTierOccupancy", "WarmTierCapacity"):
+            assert key in line, key
+            assert registry.is_declared_line_key(key), key
+        assert line["SlotRefusals"] >= 48
+        assert line["WarmTierCapacity"] == 1024
+    finally:
+        m.close()

@@ -235,3 +235,109 @@ def test_parity_fuzz_autogrow_chain(monkeypatch):
         assert s is not None
         nat.release_pins(s), ora.release_pins(s)
     assert nat.grow_count > 0
+
+
+# ------------------------------------------------------- warm-tier hooks
+
+
+def make_warm_pair(capacity, warm_capacity=256):
+    """(native, dict-oracle) pair with the warm spill/refill hooks armed."""
+    nat = DeviceWindows([make_rule()], capacity=capacity,
+                        native_slotmgr=True, warm_tier_enabled=True,
+                        warm_tier_capacity=warm_capacity)
+    assert nat.slotmgr_native and nat._warm is not None
+    ora = DeviceWindows([make_rule()], capacity=capacity,
+                        native_slotmgr=False, warm_tier_enabled=True,
+                        warm_tier_capacity=warm_capacity)
+    assert not ora.slotmgr_native and ora._warm is not None
+    return nat, ora
+
+
+def assert_same_warm_state(nat: DeviceWindows, ora: DeviceWindows, ctx=""):
+    """Warm-tier side of the parity: identical membership, identical
+    per-IP window vectors, identical spill/refill/drop accounting, and
+    identical shadow residency (a drop must keep the shadow entry in
+    BOTH modes)."""
+    assert nat.warm_spills == ora.warm_spills, ctx
+    assert nat.warm_refills == ora.warm_refills, ctx
+    assert nat.warm_dropped == ora.warm_dropped, ctx
+    assert sorted(nat._shadow) == sorted(ora._shadow), ctx
+    nk, ok_ = sorted(nat._warm.keys()), sorted(ora._warm.keys())
+    assert nk == ok_, ctx
+    for ip in nk:
+        assert nat._warm.peek(ip) == ora._warm.peek(ip), (ctx, ip)
+
+
+@pytest.mark.parametrize("capacity,seed", [(16, 11), (16, 12), (64, 13)])
+def test_parity_fuzz_warm_spill_hooks(capacity, seed):
+    """test_parity_fuzz_eviction_churn with the warm tier armed: shadow
+    entries seeded with REAL window vectors so every eviction exercises
+    the spill hook (shadow -> warm put) and every return exercises the
+    refill hook (warm take -> shadow -> pending restore), natively and
+    through the dict oracle in lockstep.  Each step also runs
+    admission_mask over a random probe batch — the three membership
+    passes (sm_contains_batch / shadow / warm.contains_batch) plus the
+    estimate gate must agree bit-for-bit between the two modes."""
+    rng = random.Random(seed)
+    nat, ora = make_warm_pair(capacity)
+    pool = [ip_of(i) for i in range(capacity * 4)]
+    held = []
+    seeded = 0
+    for step in range(200):
+        k = rng.randrange(1, capacity + 4)
+        ips = rng.sample(pool, min(k, len(pool)))
+        s = lockstep(nat, ora, ips, f"step {step}")
+        assert_same_warm_state(nat, ora, f"step {step}")
+        if s is not None:
+            if rng.random() < 0.7:
+                nat.release_pins(s), ora.release_pins(s)
+            else:
+                held.append(s)
+        while held and (s is None or rng.random() < 0.4):
+            h = held.pop(rng.randrange(len(held)))
+            nat.release_pins(h), ora.release_pins(h)
+        if rng.random() < 0.35:
+            # spill payload: a real (rule_id -> (hits, start_s, start_ns))
+            # vector, distinct per seeding so a content mismatch is loud
+            ip = rng.choice(pool)
+            seeded += 1
+            vec = {0: (seeded, 1_700_000_000 + seeded, seeded * 7)}
+            for w in (nat, ora):
+                w._shadow.setdefault(ip, dict(vec))
+        if rng.random() < 0.5:
+            probe = rng.sample(pool, rng.randrange(1, capacity))
+            est = np.zeros(len(probe), dtype=np.int64)
+            a = nat.admission_mask(probe, estimates=est, min_estimate=1)
+            b = ora.admission_mask(probe, estimates=est, min_estimate=1)
+            np.testing.assert_array_equal(a, b, err_msg=f"step {step}")
+            assert nat.slot_refusals == ora.slot_refusals, f"step {step}"
+            assert nat.sketch_admissions == ora.sketch_admissions
+    for h in held:
+        nat.release_pins(h), ora.release_pins(h)
+    assert_same_state(nat, ora, "final")
+    assert_same_warm_state(nat, ora, "final")
+    assert nat.eviction_count > 0, "fuzz never churned an eviction"
+    assert nat.warm_spills > 0, "no eviction ever spilled to warm"
+    assert nat.warm_refills > 0, "no returning IP ever refilled"
+    assert nat.slot_refusals > 0, "admission probes never refused"
+
+
+def test_warm_drop_keeps_shadow_in_both_modes():
+    """A warm tier too small to place a spill: both modes must keep the
+    shadow entry (lossless), report the drop, and stay in lockstep."""
+    nat, ora = make_warm_pair(2, warm_capacity=1)
+    vec = {0: (3, 1_700_000_123, 42)}
+    n = 10
+    for i in range(n):
+        ip = ip_of(i)
+        for w in (nat, ora):
+            w._shadow.setdefault(ip, dict(vec))
+        s = lockstep(nat, ora, [ip], f"fill {i}")
+        nat.release_pins(s), ora.release_pins(s)
+        assert_same_warm_state(nat, ora, f"fill {i}")
+    assert nat.warm_dropped > 0, "tiny tier never dropped"
+    # lossless: every evicted ip's vector is in the warm tier OR shadow
+    for i in range(n - 2):
+        ip = ip_of(i)
+        in_warm = nat._warm.peek(ip) is not None
+        assert in_warm or ip in nat._shadow, ip
