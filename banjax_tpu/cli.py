@@ -192,6 +192,27 @@ class BanjaxApp:
             health=self.health.register("tailer", stale_after=60.0),
         )
 
+        # multi-host decision fabric (banjax_tpu/fabric/): shard the IP
+        # keyspace across N banjax processes — this process keeps only
+        # its hash range, forwards the rest over peer sockets, and
+        # replicates every decision through the Kafka command path.
+        # The banner wrap must happen BEFORE the first matcher build so
+        # device decisions fan out from day one.
+        self.fabric = None
+        if getattr(config, "fabric_enabled", False):
+            from banjax_tpu.fabric.service import FabricService
+            from banjax_tpu.ingest.kafka_io import handle_command
+
+            self.fabric = FabricService(
+                config,
+                local_submit=self._fabric_local_submit,
+                apply_command=lambda cmd: handle_command(
+                    self.config_holder.get(), cmd, self.dynamic_lists
+                ),
+                health=self.health,
+            )
+            self.banner = self.fabric.wrap_banner(self.banner)
+
         # incident flight recorder (obs/flightrec.py): armed only with a
         # flightrec_dir; installed as the module-level trigger target so
         # the breaker/scheduler/SLO hooks stay one None-check when off
@@ -213,6 +234,10 @@ class BanjaxApp:
                 health=self.health,
                 slo_getter=lambda: self.slo,
                 traffic_fn=self._traffic_snapshot,
+                fabric_fn=(
+                    self._fabric_snapshot if self.fabric is not None
+                    else None
+                ),
             )
             flightrec_mod.install(self.flightrec)
 
@@ -245,6 +270,9 @@ class BanjaxApp:
             supervisor_getter=lambda: self._supervisor,
             health=self.health,
             pipeline_getter=lambda: self.pipeline,
+            fabric_getter=lambda: (
+                self.fabric.stats if self.fabric is not None else None
+            ),
         )
 
         gin_log_name = "gin.log" if config.standalone_testing else config.gin_log_file
@@ -302,7 +330,32 @@ class BanjaxApp:
             pipeline=self.pipeline, health=self.health,
             supervisor=self._supervisor, slo=self.slo,
             flightrec=self.flightrec,
+            fabric=self.fabric.stats if self.fabric is not None else None,
         )
+
+    def _fabric_snapshot(self):
+        """fabric.json for incident bundles: peer table, hash-range
+        ownership, last takeover — a shard-failure capture is
+        self-describing without asking the survivors."""
+        if self.fabric is None:
+            return {"enabled": False}
+        return self.fabric.describe()
+
+    def _fabric_local_submit(self, lines) -> int:
+        """The single-process consume path — what the fabric router
+        calls for lines THIS shard owns (and what every line takes when
+        the fabric is off)."""
+        if self.pipeline is not None:
+            # asynchronous: results surface through the pipeline's drain
+            # stage; submit() applies bounded backpressure to the tailer
+            self.pipeline.submit(lines)
+            return len(lines)
+        cfg, matcher = self._current_matcher()
+        results = matcher.consume_lines(lines)
+        if cfg.debug:
+            for result in results:
+                log.debug("consumeLine: %s", result)
+        return len(lines)
 
     def _traffic_snapshot(self):
         """traffic.json for incident bundles (obs/sketch.py): a forced
@@ -337,6 +390,11 @@ class BanjaxApp:
         return cfg, self._matcher
 
     def _consume_lines(self, lines):
+        if self.fabric is not None:
+            # keyspace-sharded: owned lines go down the local pipeline,
+            # the rest ride peer sockets to their owning shard
+            self.fabric.submit(lines)
+            return None
         if self.pipeline is not None:
             # asynchronous: results surface through the pipeline's drain
             # stage; submit() applies bounded backpressure to the tailer
@@ -352,6 +410,10 @@ class BanjaxApp:
     def start_workers(self) -> None:
         """Launch tailer, Kafka, metrics, heartbeat (not the HTTP server)."""
         config = self.config_holder.get()
+        if self.fabric is not None:
+            # listen before the tailer feeds: peers may already be
+            # forwarding this shard's range
+            self.fabric.start()
         if self.pipeline is not None:
             self.pipeline.start()
         if self.slo is not None:
@@ -389,6 +451,11 @@ class BanjaxApp:
             )
             self.kafka_writer.start()
 
+        if self.fabric is not None and self.kafka_reader is not None:
+            # fabric dedup in front of command dispatch: own-origin
+            # echoes and already-seen (origin, seq) pairs are suppressed
+            self.kafka_reader.dispatch_raw = self.fabric.dispatch_raw
+
         self.metrics.start()
 
         if not config.disable_kafka:
@@ -419,6 +486,9 @@ class BanjaxApp:
             supervisor_getter=lambda: self._supervisor,
             slo_getter=lambda: self.slo,
             flightrec_getter=lambda: self.flightrec,
+            fabric_getter=lambda: (
+                self.fabric.stats if self.fabric is not None else None
+            ),
         )
 
     async def _serve(self, install_signal_handlers: bool) -> None:
@@ -485,6 +555,10 @@ class BanjaxApp:
             self._supervisor.stop()
             self._supervisor = None
         self.tailer.stop()
+        if self.fabric is not None:
+            # after the tailer (no new routes), before the pipeline
+            # drain: peers get connection-refused and fail over
+            self.fabric.stop()
         if self.pipeline is not None:
             # tailer first (no new admissions), then drain what's in flight
             self.pipeline.stop()

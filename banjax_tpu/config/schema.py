@@ -384,6 +384,31 @@ class Config:
     # IPs at ~152 bytes + 24/rule per entry.
     warm_tier_enabled: bool = False
     warm_tier_capacity: int = 1 << 20   # entries (rounded up to 2^n)
+    # --- multi-host decision fabric (banjax_tpu/fabric/) ---
+    # shard the IP keyspace by consistent hash across N banjax processes
+    # on real sockets; lines this process does not own forward to the
+    # owning shard, decisions replicate to every peer over the Kafka
+    # command path, and a dead shard's range is taken over by its ring
+    # successors with journal replay (README "Multi-host decision
+    # fabric").
+    fabric_enabled: bool = False
+    # this shard's stable identity on the ring (must appear in
+    # fabric_peers); required when fabric_enabled
+    fabric_node_id: str = ""
+    # host:port this shard's fabric node listens on; required when
+    # fabric_enabled (port 0 = ephemeral, harness use only)
+    fabric_listen: str = ""
+    # peer table: node id -> "host:port" (this node's own id included)
+    fabric_peers: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # vnodes per node on the consistent-hash ring: more vnodes = smoother
+    # range split + smaller takeover shards, at ring-build cost
+    fabric_vnodes: int = 64
+    # per-send socket timeout on peer links; a send that cannot complete
+    # within it counts as a peer failure (retried on the shared backoff)
+    fabric_send_timeout_ms: float = 2000.0
+    # drain grace between declaring a peer dead and replaying its line
+    # journal to the takeover successors
+    fabric_takeover_grace_ms: float = 500.0
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -445,6 +470,9 @@ _SCALAR_KEYS = {
     "traffic_sketch_candidates": int,
     "slot_admission_enabled": bool, "slot_admission_min_estimate": int,
     "warm_tier_enabled": bool, "warm_tier_capacity": int,
+    "fabric_enabled": bool, "fabric_node_id": str, "fabric_listen": str,
+    "fabric_vnodes": int, "fabric_send_timeout_ms": float,
+    "fabric_takeover_grace_ms": float,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -456,7 +484,7 @@ _DICT_OR_LIST_KEYS = {
     "sitewide_sha_inv_list", "disable_logging",
     "sites_to_disable_baskerville", "sha_inv_path_exceptions",
     "dnet_to_partition", "per_site_user_agent_decision_lists",
-    "global_user_agent_decision_lists",
+    "global_user_agent_decision_lists", "fabric_peers",
 }
 
 
@@ -667,6 +695,27 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
             "config key warm_tier_capacity: expected >= 1, got "
             f"{cfg.warm_tier_capacity}"
         )
+    if cfg.fabric_vnodes < 1:
+        raise ValueError(
+            f"config key fabric_vnodes: expected >= 1, got {cfg.fabric_vnodes}"
+        )
+    if cfg.fabric_send_timeout_ms <= 0 or cfg.fabric_takeover_grace_ms < 0:
+        raise ValueError(
+            "config keys fabric_send_timeout_ms/fabric_takeover_grace_ms: "
+            f"expected positive/non-negative, got {cfg.fabric_send_timeout_ms}"
+            f"/{cfg.fabric_takeover_grace_ms}"
+        )
+    if cfg.fabric_enabled:
+        if not cfg.fabric_node_id or not cfg.fabric_listen:
+            raise ValueError(
+                "config key fabric_enabled: requires fabric_node_id and "
+                "fabric_listen"
+            )
+        if cfg.fabric_peers and cfg.fabric_node_id not in cfg.fabric_peers:
+            raise ValueError(
+                f"config key fabric_peers: missing this node's own id "
+                f"{cfg.fabric_node_id!r}"
+            )
     if cfg.flightrec_keep < 1 or cfg.flightrec_provenance_records < 1:
         raise ValueError(
             "config keys flightrec_keep/flightrec_provenance_records: "

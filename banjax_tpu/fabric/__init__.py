@@ -1,0 +1,47 @@
+"""Keyspace-sharded multi-host decision fabric.
+
+N banjax processes split the IP keyspace by consistent hash
+(`hashring`), each running the full single-process engine for its
+range.  Lines a process does not own travel to the owning shard over a
+length-prefixed socket protocol (`wire`, `peer`, `node`); resulting
+expiring Decisions replicate to every peer through the existing Kafka
+command path (`replication`) so any shard can answer for any IP.
+
+Failover is the point: a peer that stops answering (send timeout,
+breaker trip, health probe) has its hash range taken over by its ring
+successors (`router`), which re-derive the moved range's window state
+from the replayed line journal plus the replicated decisions already
+in their dynamic lists.  In-flight lines for the moving range are
+drained or counted shed — never silently lost: the PR 2 accounting
+contract (admitted == processed + shed) holds fabric-wide, summed
+across processes (`stats`).
+
+`worker` is the per-shard process entry; `harness` is the
+`dryrun_fabric` driver that proves recall 1.0 against the scenario
+oracle with a shard SIGKILLed mid-flood.
+"""
+
+from banjax_tpu.fabric.hashring import ConsistentHashRing
+from banjax_tpu.fabric.peer import PeerClient, PeerUnavailable
+from banjax_tpu.fabric.replication import (
+    DecisionReplicator,
+    FabricDeduper,
+    ReplicatingBanner,
+)
+from banjax_tpu.fabric.router import FabricRouter
+from banjax_tpu.fabric.stats import FabricStats
+from banjax_tpu.fabric.node import FabricNode
+from banjax_tpu.fabric import wire
+
+__all__ = [
+    "ConsistentHashRing",
+    "DecisionReplicator",
+    "FabricDeduper",
+    "FabricNode",
+    "FabricRouter",
+    "FabricStats",
+    "PeerClient",
+    "PeerUnavailable",
+    "ReplicatingBanner",
+    "wire",
+]

@@ -1,0 +1,563 @@
+"""dryrun_fabric driver: N real worker processes, real sockets, one box.
+
+Mirrors `__graft_entry__.dryrun_multichip` for the decision fabric: the
+driver spawns N `banjax_tpu.fabric.worker` processes (each a FULL
+engine — TPU matcher with device windows, pipeline scheduler, tiered
+state — on the CPU backend), wires them into a fabric over real TCP
+sockets plus an in-process Kafka broker for decision replication, and
+feeds a PR 9 scenario shape round-robin at the workers.  Each worker
+routes non-owned lines to the owning shard itself, so worker→worker
+socket traffic is real, not simulated.
+
+The chaos move is a mid-flood SIGKILL of one worker.  Detection is a
+failed send; recovery is deterministic journal replay from BOTH sides:
+
+  * the driver broadcasts T_PEER_DOWN so every survivor replays its
+    own forward-journal for the victim (lines survivors had routed to
+    it), and
+  * the driver replays its per-worker chunk journal (chunks it had fed
+    the victim directly).
+
+The two journals are disjoint line sets whose union is every line the
+victim ever held, so the consistent-hash successors re-derive every
+ban the victim would have emitted: recall vs the oracle is 1.0, by
+construction, with a shard killed mid-flood.  Double-processing can
+only ADD bans (precision is reported, recall is gated).
+
+Accounting is the fabric-wide ledger: every driver chunk is acked by a
+live worker (fed == acked), every worker satisfies
+admitted == processed + shed + drain_errors (pipeline) and
+local + forwarded + shed == received + replayed (fabric) — admitted
+work is processed or counted shed, never silently lost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from banjax_tpu.fabric import wire
+from banjax_tpu.fabric.peer import PeerClient, PeerUnavailable
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# driver→worker requests ride the same PeerClient as worker→worker
+# forwards; the driver's timeout must cover a synchronous takeover
+# (grace + full journal replay) behind a T_PEER_DOWN ack
+_DRIVER_TIMEOUT_MS = 120_000.0
+
+
+def _fake_broker():
+    try:
+        from tests.fake_kafka_broker import FakeKafkaBroker
+    except ImportError:  # pragma: no cover — installed-package layout
+        sys.path.insert(0, _REPO)
+        from tests.fake_kafka_broker import FakeKafkaBroker
+    return FakeKafkaBroker()
+
+
+class _Worker:
+    """One spawned shard process + the driver's client to it."""
+
+    def __init__(self, wid: str, proc: subprocess.Popen):
+        self.wid = wid
+        self.proc = proc
+        self.port: Optional[int] = None
+        self.client: Optional[PeerClient] = None
+        self.ready_error: Optional[str] = None
+
+    def read_ready(self, timeout_s: float) -> None:
+        """Block until the worker prints its READY line (post-warmup,
+        post-kafka-attach) — in a thread so N workers warm in parallel."""
+        result: Dict[str, object] = {}
+
+        def _read():
+            for raw in iter(self.proc.stdout.readline, b""):
+                try:
+                    msg = json.loads(raw)
+                except ValueError:
+                    continue  # stray non-JSON noise on stdout
+                if isinstance(msg, dict) and "ready" in msg:
+                    result.update(msg)
+                    return
+
+        t = threading.Thread(target=_read, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if not result.get("ready"):
+            self.ready_error = str(
+                result.get("error") or f"no READY within {timeout_s}s"
+            )
+            return
+        self.port = int(result["port"])
+        self.client = PeerClient(
+            self.wid, "127.0.0.1", self.port,
+            send_timeout_ms=_DRIVER_TIMEOUT_MS, max_attempts=2,
+        )
+
+    def request(self, ftype: int, payload: dict) -> dict:
+        assert self.client is not None, f"{self.wid} has no client"
+        _rtype, rpayload = self.client.request(ftype, payload)
+        return rpayload
+
+    def kill(self) -> None:
+        self.proc.kill()
+
+    def shutdown(self) -> None:
+        try:
+            if self.client is not None:
+                self.client.request(wire.T_SHUTDOWN, {})
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=5)
+        if self.client is not None:
+            self.client.close()
+
+
+def _spawn(wid: str, broker_port: int, stderr_path: Optional[str]) -> _Worker:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if stderr_path:
+        os.makedirs(os.path.dirname(stderr_path), exist_ok=True)
+        stderr = open(stderr_path, "ab")
+    else:
+        stderr = subprocess.DEVNULL
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "banjax_tpu.fabric.worker",
+         "--node-id", wid, "--broker-port", str(broker_port)],
+        stdout=subprocess.PIPE, stderr=stderr, cwd=_REPO, env=env,
+    )
+    return _Worker(wid, proc)
+
+
+class FabricDryrun:
+    """One dryrun episode.  `run()` returns the report dict; every
+    invariant it computes is in report["invariants"] (all must hold)."""
+
+    def __init__(
+        self,
+        n_workers: int = 2,
+        shape: str = "flash_crowd",
+        seed: int = 20260804,
+        scale: float = 1.0,
+        kill: bool = True,
+        rejoin: bool = False,
+        kill_frac: float = 0.45,
+        ready_timeout_s: float = 420.0,
+        settle_timeout_s: float = 120.0,
+        log_dir: Optional[str] = None,
+    ):
+        if kill and n_workers < 2:
+            raise ValueError("kill needs n_workers >= 2")
+        self.n_workers = n_workers
+        self.shape = shape
+        self.seed = seed
+        self.scale = scale
+        self.kill = kill
+        self.rejoin = rejoin
+        self.kill_frac = kill_frac
+        self.ready_timeout_s = ready_timeout_s
+        self.settle_timeout_s = settle_timeout_s
+        self.log_dir = log_dir
+        self.workers: Dict[str, _Worker] = {}
+        self.alive: List[str] = []
+        self.victim: Optional[str] = None
+        # driver-side journal: every chunk acked per worker, so the
+        # driver can replay a dead worker's direct feed
+        self._journal: Dict[str, List[List[str]]] = {}
+        self._rr = 0
+        self.fed_lines = 0
+        self.acked_lines = 0
+        self.takeover: Dict[str, object] = {}
+
+    # ---- plumbing ----
+
+    def _stats(self, wid: str) -> dict:
+        return self.workers[wid].request(wire.T_STATS, {})
+
+    def _broadcast(self, ftype: int, payload: dict,
+                   only: Optional[List[str]] = None) -> None:
+        for wid in list(only if only is not None else self.alive):
+            self.workers[wid].request(ftype, payload)
+
+    def _send_chunk(self, lines: List[str], count_ack: bool = True) -> str:
+        """Round-robin one chunk at a live worker; a dead target turns
+        into detection + takeover + reroute, never a lost chunk.
+        Replayed chunks pass count_ack=False: the victim already acked
+        them once, so the fed==acked ledger counts each chunk once."""
+        while True:
+            if not self.alive:
+                raise RuntimeError("no live workers left")
+            target = self.alive[self._rr % len(self.alive)]
+            self._rr += 1
+            try:
+                self.workers[target].request(
+                    wire.T_LINES, {"lines": lines, "route": True}
+                )
+            except (PeerUnavailable, OSError):
+                self._on_death(target)
+                continue
+            self._journal[target].append(lines)
+            if count_ack:
+                self.acked_lines += len(lines)
+            return target
+
+    def _on_death(self, wid: str) -> None:
+        """A send to `wid` failed: declare it dead fabric-wide and
+        replay the driver's direct feed to the survivors."""
+        if wid not in self.alive:
+            return
+        self.alive.remove(wid)
+        t0 = time.perf_counter()
+        pre = {w: self._stats(w) for w in self.alive}
+        # survivors replay their forward-journals inside this ack
+        self._broadcast(wire.T_PEER_DOWN, {"peer": wid})
+        replayed = 0
+        for chunk in self._journal[wid]:
+            self._send_chunk(chunk, count_ack=False)
+            replayed += len(chunk)
+        self._journal[wid] = []
+        post = {w: self._stats(w) for w in self.alive}
+
+        def _shed(snap: dict) -> int:
+            return int(snap["sched"]["PipelineShedLines"]) + int(
+                snap["fabric"]["FabricShedLines"]
+            )
+
+        shed_in_window = sum(
+            _shed(post[w]) - _shed(pre[w]) for w in post
+        )
+        survivor_replayed = sum(
+            int(post[w]["fabric"]["FabricReplayedLines"])
+            - int(pre[w]["fabric"]["FabricReplayedLines"])
+            for w in post
+        )
+        fed_in_window = replayed + survivor_replayed
+        self.takeover = {
+            "victim": wid,
+            "detect_after_lines": self.fed_lines,
+            "driver_replayed_lines": replayed,
+            "survivor_replayed_lines": survivor_replayed,
+            "shed_in_window": shed_in_window,
+            "fed_in_window": fed_in_window,
+            "shed_ratio_in_window": round(
+                shed_in_window / max(1, fed_in_window), 6
+            ),
+            "window_s": round(time.perf_counter() - t0, 3),
+        }
+
+    def _settle(self, tagged_floor: Optional[int] = None,
+                skip_kafka_check: Optional[List[str]] = None) -> None:
+        """FLUSH everyone, then poll STATS until counters quiesce (and
+        each long-lived worker has consumed every fabric-tagged command
+        the broker holds — suppressed + applied covers the topic)."""
+        self._broadcast(wire.T_FLUSH, {"timeout": 600})
+        deadline = time.monotonic() + self.settle_timeout_s
+        stable, prev = 0, None
+        skip = set(skip_kafka_check or ())
+        while stable < 3:
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"fabric settle timed out: {prev}")
+            snaps = {w: self._stats(w) for w in self.alive}
+            kafka_ok = True
+            if tagged_floor is not None:
+                tagged = self._tagged_commands()
+                for w, s in snaps.items():
+                    if w in skip:
+                        continue
+                    seen = int(
+                        s["fabric"]["FabricDuplicatesSuppressed"]
+                    ) + int(s["fabric"]["FabricReplicatedApplied"])
+                    if seen < tagged:
+                        kafka_ok = False
+            key = tuple(
+                (w,
+                 s["sched"]["PipelineAdmittedLines"],
+                 s["sched"]["PipelineProcessedLines"],
+                 s["sched"]["PipelineShedLines"],
+                 len(s["bans"]),
+                 s["fabric"]["FabricReplicatedApplied"],
+                 s["fabric"]["FabricDuplicatesSuppressed"])
+                for w, s in sorted(snaps.items())
+            )
+            if key == prev and kafka_ok:
+                stable += 1
+            else:
+                stable = 0
+            prev = key
+            time.sleep(0.2)
+
+    def _tagged_commands(self) -> int:
+        log = self.broker.logs.get(("fabric.commands", 0), [])
+        return sum(
+            1 for m in log
+            if b"fabric_origin" in m and b"fabric_ping" not in m
+        )
+
+    # ---- the run ----
+
+    def run(self) -> dict:
+        from banjax_tpu.config.schema import config_from_yaml_text
+        from banjax_tpu.scenarios import oracle as oracle_mod
+        from banjax_tpu.scenarios.shapes import LineChunk, generate
+
+        sc = generate(self.shape, self.seed, self.scale)
+        chunks = [
+            list(ev.lines) for ev in sc.events if isinstance(ev, LineChunk)
+        ]
+        n_lines = sum(len(c) for c in chunks)
+
+        self.broker = _fake_broker().start()
+        wids = [f"w{i}" for i in range(self.n_workers)]
+        try:
+            return self._run_inner(sc, chunks, n_lines, wids,
+                                   config_from_yaml_text, oracle_mod)
+        finally:
+            for w in self.workers.values():
+                w.shutdown()
+            self.broker.stop()
+
+    def _hello_payload(self) -> dict:
+        return {
+            "peers": {
+                w.wid: ["127.0.0.1", w.port]
+                for w in self.workers.values() if w.port is not None
+            },
+            "vnodes": 64,
+            "send_timeout_ms": 2000.0,
+            "grace_ms": 200.0,
+        }
+
+    def _spawn_and_hello(self, wids: List[str]) -> None:
+        for wid in wids:
+            err_path = (
+                os.path.join(self.log_dir, f"{wid}.err")
+                if self.log_dir else None
+            )
+            self.workers[wid] = _spawn(wid, self.broker.port, err_path)
+        threads = [
+            threading.Thread(
+                target=w.read_ready, args=(self.ready_timeout_s,),
+                daemon=True,
+            )
+            for w in self.workers.values() if w.wid in wids
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.ready_timeout_s + 5)
+        bad = [
+            f"{w.wid}: {w.ready_error}"
+            for w in self.workers.values() if w.wid in wids and w.port is None
+        ]
+        if bad:
+            raise RuntimeError(f"workers failed to start: {bad}")
+
+    def _run_inner(self, sc, chunks, n_lines, wids,
+                   config_from_yaml_text, oracle_mod) -> dict:
+        self._spawn_and_hello(wids)
+        self.alive = list(wids)
+        self._journal = {w: [] for w in wids}
+        hello = self._hello_payload()
+        self._broadcast(wire.T_HELLO, hello)
+        base = {w: self._stats(w) for w in self.alive}
+
+        kill_at = (
+            int(self.kill_frac * len(chunks)) if self.kill else -1
+        )
+        self.victim = wids[-1] if self.kill else None
+
+        t_feed = time.perf_counter()
+        for i, chunk in enumerate(chunks):
+            if i == kill_at and self.victim in self.alive:
+                # SIGKILL mid-flood: no goodbye, no flush — the next
+                # send to it is the detection
+                self.workers[self.victim].kill()
+            self._send_chunk(chunk)
+            self.fed_lines += len(chunk)
+        # a victim killed on the very last chunks may never be hit by
+        # the round-robin again: force detection so takeover happens
+        if self.victim is not None and self.victim in self.alive:
+            try:
+                self.workers[self.victim].request(wire.T_PING, {})
+            except (PeerUnavailable, OSError):
+                self._on_death(self.victim)
+        self._settle(tagged_floor=0)
+        # second settle pass with the final tagged count: every
+        # survivor must have consumed the whole replicated topic
+        self._settle(tagged_floor=self._tagged_commands())
+        feed_s = max(1e-9, time.perf_counter() - t_feed)
+
+        final = {w: self._stats(w) for w in self.alive}
+        report = self._report(
+            sc, n_lines, feed_s, base, final,
+            config_from_yaml_text, oracle_mod,
+        )
+        if self.rejoin and self.victim is not None:
+            report["rejoin"] = self._rejoin_phase()
+        return report
+
+    # ---- rejoin / handback ----
+
+    def _rejoin_phase(self) -> dict:
+        from banjax_tpu.scenarios.shapes import LineChunk, generate
+
+        victim = self.victim
+        survivor = self.alive[0]
+        # warm-start state for the newcomer: a survivor's decision
+        # snapshot, applied idempotently over the wire
+        snap = self.workers[survivor].request(wire.T_SNAPSHOT, {})
+        self._spawn_and_hello([victim])
+        newcomer = self.workers[victim]
+        newcomer.request(wire.T_HELLO, self._hello_payload())
+        sync_ack = newcomer.request(
+            wire.T_SYNC, {"decisions": snap["decisions"]}
+        )
+        # handback is pure membership: ring recomputation, NO replay
+        self._broadcast(
+            wire.T_PEER_UP,
+            {"peer": victim, "host": "127.0.0.1", "port": newcomer.port},
+        )
+        self.alive.append(victim)
+
+        base = {w: self._stats(w) for w in self.alive}
+        wave = generate(self.shape, self.seed + 1,
+                        max(0.25, self.scale * 0.25))
+        wave_chunks = [
+            list(ev.lines) for ev in wave.events
+            if isinstance(ev, LineChunk)
+        ]
+        wave_lines = sum(len(c) for c in wave_chunks)
+        for chunk in wave_chunks:
+            self._send_chunk(chunk)
+            self.fed_lines += len(chunk)
+        # the rejoined worker's reader attached at the topic tail; the
+        # whole-topic floor only applies to the original survivors
+        self._settle(tagged_floor=self._tagged_commands(),
+                     skip_kafka_check=[victim])
+        final = {w: self._stats(w) for w in self.alive}
+
+        def _local(w: str) -> int:
+            return int(final[w]["fabric"]["FabricLocalLines"]) - int(
+                base[w]["fabric"]["FabricLocalLines"]
+            )
+
+        locals_sum = sum(_local(w) for w in self.alive)
+        return {
+            "snapshot_decisions": len(snap["decisions"]),
+            "sync_applied": int(sync_ack.get("applied", 0)),
+            "wave_lines": wave_lines,
+            "wave_locals_sum": locals_sum,
+            "newcomer_local_lines": _local(victim),
+            "invariants": {
+                # every handed-back line processed EXACTLY once
+                # fabric-wide — no double-processing on rejoin
+                "wave_exactly_once": locals_sum == wave_lines,
+                "newcomer_took_lines": _local(victim) > 0,
+                "sync_idempotent_applied":
+                    int(sync_ack.get("applied", 0))
+                    == len(snap["decisions"]),
+            },
+        }
+
+    # ---- reporting ----
+
+    def _report(self, sc, n_lines, feed_s, base, final,
+                config_from_yaml_text, oracle_mod) -> dict:
+        engine_bans: List[Tuple[str, str]] = []
+        for w in self.alive:
+            engine_bans.extend(
+                (ip, rule) for ip, rule in final[w]["bans"]
+            )
+        cfg = config_from_yaml_text(sc.rules_yaml)
+        oracle_bans = oracle_mod.expected_bans(sc, cfg)
+        precision, recall, tp = oracle_mod.precision_recall(
+            engine_bans, oracle_bans
+        )
+
+        per_worker = {}
+        invariants: Dict[str, bool] = {}
+        dup_total = 0
+        for w in self.alive:
+            sched_d = {
+                k: int(final[w]["sched"][k]) - int(base[w]["sched"][k])
+                for k in ("PipelineAdmittedLines", "PipelineProcessedLines",
+                          "PipelineShedLines", "PipelineDrainErrorLines")
+            }
+            fab = {k: int(v) for k, v in final[w]["fabric"].items()}
+            dup_total += fab["FabricDuplicatesSuppressed"]
+            per_worker[w] = {"sched_delta": sched_d, "fabric": fab,
+                             "router": final[w]["router"]}
+            invariants[f"{w}_pipeline_accounting"] = (
+                sched_d["PipelineAdmittedLines"]
+                == sched_d["PipelineProcessedLines"]
+                + sched_d["PipelineShedLines"]
+                + sched_d["PipelineDrainErrorLines"]
+            )
+            # fabric ledger: every line that ENTERED this worker
+            # (received over the wire, or re-materialized from its
+            # journal at takeover) left as exactly one of
+            # local/forwarded/shed
+            invariants[f"{w}_fabric_ledger"] = (
+                fab["FabricLocalLines"] + fab["FabricForwardedLines"]
+                + fab["FabricShedLines"]
+                == fab["FabricReceivedLines"] + fab["FabricReplayedLines"]
+            )
+        invariants["driver_fed_equals_acked"] = (
+            self.fed_lines == self.acked_lines
+        )
+        invariants["recall_one"] = recall == 1.0
+        if self.kill:
+            invariants["takeover_happened"] = bool(self.takeover)
+            invariants["survivors_took_over"] = all(
+                per_worker[w]["fabric"]["FabricTakeovers"] >= 1
+                for w in self.alive
+            )
+            invariants["victim_in_last_takeover"] = all(
+                ((final[w]["router"] or {}).get("last_takeover") or {})
+                .get("peer") == self.victim
+                for w in self.alive
+            )
+        if self.n_workers > 1 and engine_bans:
+            # every replicated decision echoes back to its origin and
+            # is suppressed there: the idempotency witness
+            invariants["duplicates_suppressed"] = dup_total > 0
+
+        return {
+            "harness": "dryrun_fabric",
+            "n_workers": self.n_workers,
+            "shape": self.shape,
+            "seed": self.seed,
+            "scale": self.scale,
+            "killed": self.victim,
+            "n_lines": n_lines,
+            "fed_lines": self.fed_lines,
+            "acked_lines": self.acked_lines,
+            "feed_s": round(feed_s, 3),
+            "lines_per_sec": round(n_lines / feed_s, 1),
+            "engine_bans": len(engine_bans),
+            "oracle_bans": len(oracle_bans),
+            "true_positives": tp,
+            "precision": round(precision, 6),
+            "recall": round(recall, 6),
+            "duplicates_suppressed": dup_total,
+            "takeover": self.takeover,
+            "per_worker": per_worker,
+            "invariants": invariants,
+        }
+
+
+def run_fabric(**kwargs) -> dict:
+    """Convenience wrapper: one episode, report dict back."""
+    return FabricDryrun(**kwargs).run()

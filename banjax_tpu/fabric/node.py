@@ -1,0 +1,111 @@
+"""Server side of a fabric shard: accepts peer/driver connections and
+dispatches frames to registered handlers.
+
+Thread-per-connection (peer counts are single digits), one synchronous
+response per request.  The frame-read path passes the `fabric.recv`
+failpoint; an injected fault drops the connection exactly like a torn
+network would, so the client exercises its reconnect backoff.  Handler
+exceptions answer T_ERR and keep the connection — an application error
+must not masquerade as a dead shard.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from banjax_tpu.fabric import wire
+from banjax_tpu.resilience import failpoints
+
+Handler = Callable[[Dict[str, Any]], Tuple[int, Dict[str, Any]]]
+
+
+class FabricNode:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        handlers: Optional[Dict[int, Handler]] = None,
+    ):
+        self.handlers: Dict[int, Handler] = dict(handlers or {})
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conn_threads: list = []
+
+    def on(self, ftype: int, handler: Handler) -> None:
+        self.handlers[ftype] = handler
+
+    def start(self) -> "FabricNode":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fabric-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        try:
+            self._sock.settimeout(0.25)
+        except OSError:
+            return  # stop() closed the socket before the thread ran
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="fabric-conn", daemon=True,
+            )
+            t.start()
+            self._conn_threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.settimeout(0.5)
+        try:
+            while not self._stop.is_set():
+                try:
+                    ftype, payload = wire.recv_frame(conn)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                try:
+                    failpoints.check("fabric.recv")
+                except failpoints.FaultInjected:
+                    return  # injected torn network: drop the connection
+                handler = self.handlers.get(ftype)
+                if handler is None:
+                    rtype, rpayload = wire.T_ERR, {
+                        "error": f"unhandled frame type {ftype}"
+                    }
+                else:
+                    try:
+                        rtype, rpayload = handler(payload)
+                    except Exception as exc:  # answer, don't die
+                        rtype, rpayload = wire.T_ERR, {"error": repr(exc)}
+                try:
+                    wire.send_frame(conn, rtype, rpayload)
+                except OSError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)

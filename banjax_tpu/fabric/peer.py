@@ -1,0 +1,127 @@
+"""Client side of a fabric peer link.
+
+One persistent connection per peer, request/response serialized under a
+lock.  Every send attempt passes the `fabric.send` failpoint, carries a
+per-send socket timeout (`fabric_send_timeout_ms`), and on failure the
+connection is torn down and retried on the shared reconnect backoff
+(resilience/backoff.py — the same policy as the kafka and tailer
+loops).  A per-peer circuit breaker turns repeated failures into a fast
+PeerUnavailable so the router can start a takeover instead of timing
+out on every chunk for a dead shard.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from banjax_tpu.fabric import wire
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.resilience.backoff import Backoff, reconnect_backoff
+from banjax_tpu.resilience.breaker import CircuitBreaker
+
+
+class PeerUnavailable(OSError):
+    """The peer did not answer within the retry budget (or its breaker
+    is open) — the caller should treat the shard as dead."""
+
+
+class PeerClient:
+    def __init__(
+        self,
+        peer_id: str,
+        host: str,
+        port: int,
+        send_timeout_ms: float = 2000.0,
+        max_attempts: int = 3,
+        backoff: Optional[Backoff] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        stop: Optional[threading.Event] = None,
+    ):
+        self.peer_id = peer_id
+        self.host = host
+        self.port = int(port)
+        self.send_timeout_s = float(send_timeout_ms) / 1000.0
+        self.max_attempts = int(max_attempts)
+        # short cap: a fabric peer link recovers or fails over in
+        # hundreds of ms, not the 30 s a kafka broker is allowed
+        self.backoff = backoff or reconnect_backoff(cap=1.0, base=0.05)
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=max(2, max_attempts),
+            recovery_seconds=2.0,
+            name=f"fabric.peer.{peer_id}",
+        )
+        self._stop = stop or threading.Event()
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def connect_to(self, host: str, port: int) -> None:
+        """Re-point at a rejoined peer's new address."""
+        with self._lock:
+            self._close_locked()
+            self.host = host
+            self.port = int(port)
+
+    def request(
+        self, ftype: int, payload: Dict[str, Any]
+    ) -> Tuple[int, Dict[str, Any]]:
+        """Send one frame, wait for its response.  Raises
+        PeerUnavailable after `max_attempts` failed tries (reconnecting
+        on the shared backoff between tries)."""
+        if not self.breaker.allow():
+            raise PeerUnavailable(
+                f"peer {self.peer_id}: breaker {self.breaker.state}"
+            )
+        last_err: Optional[BaseException] = None
+        with self._lock:
+            for attempt in range(self.max_attempts):
+                if attempt and self.backoff.wait(self._stop):
+                    break
+                try:
+                    failpoints.check("fabric.send")
+                    sock = self._ensure_sock_locked()
+                    wire.send_frame(sock, ftype, payload)
+                    rtype, rpayload = wire.recv_frame(sock)
+                except (OSError, socket.timeout) as exc:
+                    last_err = exc
+                    self._close_locked()
+                    self.breaker.record_failure()
+                    continue
+                if rtype == wire.T_ERR:
+                    # the peer is alive and answering: an application
+                    # error is not a connectivity failure
+                    self.breaker.record_success()
+                    self.backoff.reset()
+                    raise OSError(
+                        f"peer {self.peer_id} error: "
+                        f"{rpayload.get('error', '?')}"
+                    )
+                self.breaker.record_success()
+                self.backoff.reset()
+                return rtype, rpayload
+        raise PeerUnavailable(
+            f"peer {self.peer_id} unavailable after "
+            f"{self.max_attempts} attempts: {last_err}"
+        )
+
+    def _ensure_sock_locked(self) -> socket.socket:
+        if self._sock is None:
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self.send_timeout_s
+            )
+            sock.settimeout(self.send_timeout_s)
+            self._sock = sock
+        return self._sock
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()

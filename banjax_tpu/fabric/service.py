@@ -1,0 +1,160 @@
+"""Production assembly of the decision fabric for cli.BanjaxApp.
+
+One FabricService per process, built only when `fabric_enabled`.  It
+owns the four fabric pieces and exposes exactly the seams the app
+needs:
+
+  * ``submit(lines)`` — the tailer's consume path: lines this shard
+    owns go down the local pipeline, everything else rides a peer
+    socket to its owner (router.py);
+  * ``wrap_banner(banner)`` — decisions fan out to the command topic
+    (replication.py) on top of whatever the inner banner effects;
+  * ``dispatch_raw(raw)`` — the KafkaReader drain hook: own-origin
+    echoes and duplicate (origin, seq) pairs are suppressed, fresh
+    peer decisions are applied (idempotently) to the dynamic lists;
+  * ``describe()`` — the flight recorder's fabric.json and the
+    /metrics peer table.
+
+The wire server handles peer frames only (LINES / PING / PEER_DOWN /
+PEER_UP / STATS); topology is static from `fabric_peers` — dynamic
+membership changes arrive as PEER_DOWN/PEER_UP frames or are detected
+locally by a failed send.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from banjax_tpu.fabric import wire
+from banjax_tpu.fabric.hashring import ConsistentHashRing
+from banjax_tpu.fabric.node import FabricNode
+from banjax_tpu.fabric.peer import PeerClient
+from banjax_tpu.fabric.replication import (
+    DecisionReplicator,
+    FabricDeduper,
+    ReplicatingBanner,
+)
+from banjax_tpu.fabric.router import FabricRouter
+from banjax_tpu.fabric.stats import FabricStats
+
+
+def _split_addr(addr: str) -> tuple:
+    host, _, port = str(addr).rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class FabricService:
+    def __init__(
+        self,
+        config: Any,
+        local_submit: Callable[[Sequence[str]], int],
+        apply_command: Callable[[Dict[str, Any]], None],
+        health=None,
+        transport: Any = None,
+    ):
+        if transport is None:
+            from banjax_tpu.ingest.kafka_wire import WireKafkaTransport
+
+            transport = WireKafkaTransport()
+        self.node_id = config.fabric_node_id
+        self.stats = FabricStats()
+        peers_cfg = dict(config.fabric_peers or {})
+        node_ids = sorted(peers_cfg) if peers_cfg else [self.node_id]
+        ring = ConsistentHashRing(node_ids, vnodes=config.fabric_vnodes)
+        clients: Dict[str, Optional[PeerClient]] = {}
+        for pid in node_ids:
+            if pid == self.node_id:
+                clients[pid] = None
+                continue
+            phost, pport = _split_addr(peers_cfg[pid])
+            clients[pid] = PeerClient(
+                pid, phost, pport,
+                send_timeout_ms=config.fabric_send_timeout_ms,
+            )
+        self.replicator = DecisionReplicator(
+            self.node_id, transport, config.kafka_command_topic,
+            stats=self.stats, config=config, local_apply=apply_command,
+        )
+        self.deduper = FabricDeduper(
+            self.node_id, apply_command, stats=self.stats
+        )
+        self.router = FabricRouter(
+            self.node_id, ring, clients, local_submit,
+            stats=self.stats, health=health,
+            takeover_grace_ms=config.fabric_takeover_grace_ms,
+        )
+        lhost, lport = _split_addr(config.fabric_listen)
+        self.node = FabricNode(lhost, lport, handlers={
+            wire.T_LINES: self._h_lines,
+            wire.T_PING: self._h_ping,
+            wire.T_PEER_DOWN: self._h_peer_down,
+            wire.T_PEER_UP: self._h_peer_up,
+            wire.T_STATS: self._h_stats,
+        })
+        self._local_submit = local_submit
+
+    # ---- lifecycle ----
+
+    def start(self) -> "FabricService":
+        self.node.start()
+        return self
+
+    def stop(self) -> None:
+        self.node.stop()
+        for client in self.router.peers.values():
+            if client is not None:
+                client.close()
+
+    # ---- app seams ----
+
+    def submit(self, lines: Sequence[str]) -> Dict[str, int]:
+        """The tailer's consume path: route every line to its owner."""
+        return self.router.route(lines)
+
+    def wrap_banner(self, banner: Any) -> ReplicatingBanner:
+        return ReplicatingBanner(banner, self.replicator)
+
+    def dispatch_raw(self, raw: Any) -> None:
+        """KafkaReader drain hook (replaces the default dispatch)."""
+        self.deduper.dispatch(raw)
+
+    def describe(self) -> Dict[str, object]:
+        out: Dict[str, object] = {"enabled": True}
+        out.update(self.router.describe())
+        out["stats"] = self.stats.peek()
+        return out
+
+    # ---- wire handlers (peer side) ----
+
+    def _h_lines(self, payload: dict):
+        lines = payload.get("lines", [])
+        self.stats.note_received(len(lines))
+        if payload.get("route"):
+            out = self.router.route(lines)
+            return wire.T_ACK, {"n": len(lines), **out}
+        self._local_submit(lines)
+        self.stats.note_local(len(lines))
+        return wire.T_ACK, {"n": len(lines), "local": len(lines)}
+
+    def _h_ping(self, payload: dict):
+        return wire.T_PONG, {"node_id": self.node_id}
+
+    def _h_peer_down(self, payload: dict):
+        self.router.mark_dead(
+            str(payload.get("peer", "")), reason="peer_down frame"
+        )
+        return wire.T_ACK, {}
+
+    def _h_peer_up(self, payload: dict):
+        self.router.mark_alive(
+            str(payload.get("peer", "")),
+            host=payload.get("host"), port=payload.get("port"),
+        )
+        return wire.T_ACK, {}
+
+    def _h_stats(self, payload: dict):
+        return wire.T_STATS_R, {
+            "node_id": self.node_id,
+            "fabric": self.stats.peek(),
+            "router": self.router.describe(),
+        }

@@ -1,0 +1,117 @@
+"""Fabric counters — the cross-process half of the accounting contract.
+
+`FabricStats` mirrors the pipeline's PipelineStats shape: note_* under
+one lock, `peek()` returns the registry line keys.  The counters close
+the fabric-wide ledger the single-process invariant cannot see:
+
+    fed == acked + shed            (driver/router view, per chunk)
+    received == local + forwarded + shed   (per shard)
+
+summed with every shard's `admitted == processed + shed + drain_errors`
+they prove no line entered the fabric and vanished, even across a
+SIGKILL + takeover.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from banjax_tpu.obs.registry import Histogram
+
+
+class FabricStats:
+    """Thread-safe fabric counters + takeover duration histogram."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.forwarded_lines = 0       # sent to a peer and acked
+        self.received_lines = 0        # arrived over the wire from a peer
+        self.local_lines = 0           # owned locally, submitted in-process
+        self.shed_lines = 0            # no alive owner — counted, never silent
+        self.replayed_lines = 0        # journal replay after a takeover
+        self.replicated_decisions = 0  # decisions produced to the command topic
+        self.replication_errors = 0    # produce attempts that failed (retried)
+        self.duplicate_suppressed = 0  # replicated commands dropped by dedupe
+        self.replicated_applied = 0    # replicated commands applied locally
+        self.takeovers = 0
+        self.takeover_duration = Histogram()
+        self.peer_up: Dict[str, bool] = {}
+        self.last_takeover: Optional[Dict[str, object]] = None
+
+    def note_forwarded(self, n: int) -> None:
+        with self._lock:
+            self.forwarded_lines += n
+
+    def note_received(self, n: int) -> None:
+        with self._lock:
+            self.received_lines += n
+
+    def note_local(self, n: int) -> None:
+        with self._lock:
+            self.local_lines += n
+
+    def note_shed(self, n: int) -> None:
+        with self._lock:
+            self.shed_lines += n
+
+    def note_replayed(self, n: int) -> None:
+        with self._lock:
+            self.replayed_lines += n
+
+    def note_replicated(self, n: int = 1) -> None:
+        with self._lock:
+            self.replicated_decisions += n
+
+    def note_replication_error(self) -> None:
+        with self._lock:
+            self.replication_errors += 1
+
+    def note_duplicate_suppressed(self) -> None:
+        with self._lock:
+            self.duplicate_suppressed += 1
+
+    def note_replicated_applied(self) -> None:
+        with self._lock:
+            self.replicated_applied += 1
+
+    def note_peer(self, peer_id: str, up: bool) -> None:
+        with self._lock:
+            self.peer_up[peer_id] = up
+
+    def note_takeover(
+        self, peer_id: str, duration_s: float, replayed_lines: int
+    ) -> None:
+        with self._lock:
+            self.takeovers += 1
+            self.last_takeover = {
+                "peer": peer_id,
+                "duration_s": duration_s,
+                "replayed_lines": replayed_lines,
+            }
+        self.takeover_duration.observe(duration_s)
+
+    def peek(self) -> Dict[str, object]:
+        """Registry line keys (obs/registry.py `Fabric*` families)."""
+        with self._lock:
+            return {
+                "FabricForwardedLines": self.forwarded_lines,
+                "FabricReceivedLines": self.received_lines,
+                "FabricLocalLines": self.local_lines,
+                "FabricShedLines": self.shed_lines,
+                "FabricReplayedLines": self.replayed_lines,
+                "FabricReplicatedDecisions": self.replicated_decisions,
+                "FabricReplicationErrors": self.replication_errors,
+                "FabricDuplicatesSuppressed": self.duplicate_suppressed,
+                "FabricReplicatedApplied": self.replicated_applied,
+                "FabricTakeovers": self.takeovers,
+            }
+
+    def peers_snapshot(self) -> Dict[str, bool]:
+        with self._lock:
+            return dict(self.peer_up)
+
+    def takeover_snapshot(
+        self,
+    ) -> Tuple[Tuple[float, ...], list, float, int]:
+        return self.takeover_duration.snapshot()

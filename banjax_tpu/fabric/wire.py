@@ -1,0 +1,89 @@
+"""Length-prefixed fabric socket frames.
+
+One frame = 4-byte big-endian length, 1-byte type, JSON payload.  The
+length covers the type byte + payload, so a reader can pre-allocate
+and a torn stream fails loudly (oversized or truncated frames raise
+instead of desynchronizing).  Every exchange is a synchronous
+request -> response pair on one connection; the client serializes
+requests under its own lock, which is what makes the LINES -> ACK
+accounting exact (a chunk is acked at most once, and the ack carries
+the receiving shard's admitted count).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Dict, Tuple
+
+MAX_FRAME_BYTES = 32 << 20  # one scenario chunk is ~32 KiB; 32 MiB is sabotage
+
+_HEADER = struct.Struct("!IB")
+
+# frame types — request/response pairs share a row
+T_HELLO = 1        # -> T_HELLO_R     driver/peer handshake, topology push
+T_HELLO_R = 2
+T_LINES = 3        # -> T_ACK         log lines to route/process
+T_ACK = 4
+T_STATS = 5        # -> T_STATS_R     scheduler + fabric counters + ban log
+T_STATS_R = 6
+T_PING = 7         # -> T_PONG        liveness probe
+T_PONG = 8
+T_SNAPSHOT = 9     # -> T_SNAPSHOT_R  dump expiring decisions (rejoin source)
+T_SNAPSHOT_R = 10
+T_SYNC = 11        # -> T_ACK         apply a decision snapshot idempotently
+T_PEER_DOWN = 12   # -> T_ACK         membership change: mark peer dead
+T_PEER_UP = 13     # -> T_ACK         membership change: peer rejoined
+T_FLUSH = 14       # -> T_ACK         drain the pipeline to quiescence
+T_SHUTDOWN = 15    # -> T_ACK         clean exit
+T_ERR = 16         # any request may answer this; payload has "error"
+
+
+class FrameError(OSError):
+    """Malformed or oversized frame — the connection is unusable."""
+
+
+def send_frame(sock: socket.socket, ftype: int, payload: Dict[str, Any]) -> None:
+    body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
+    if 1 + len(body) > MAX_FRAME_BYTES:
+        raise FrameError(f"frame too large: {len(body)} bytes")
+    sock.sendall(_HEADER.pack(1 + len(body), ftype) + body)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[int, Dict[str, Any]]:
+    header = _recv_exact(sock, _HEADER.size)
+    length, ftype = _HEADER.unpack(header)
+    if length < 1 or length > MAX_FRAME_BYTES:
+        raise FrameError(f"bad frame length {length}")
+    body = _recv_exact(sock, length - 1, committed=True)
+    try:
+        payload = json.loads(body.decode("utf-8")) if length > 1 else {}
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise FrameError(f"undecodable frame payload: {exc}") from exc
+    if not isinstance(payload, dict):
+        raise FrameError("frame payload must be a JSON object")
+    return ftype, payload
+
+
+def _recv_exact(
+    sock: socket.socket, n: int, committed: bool = False
+) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            chunk = sock.recv(n - got)
+        except socket.timeout:
+            if got or committed:
+                # a stall mid-frame would desynchronize the stream if
+                # surfaced as an idle timeout — fail the connection
+                raise FrameError(
+                    f"timeout mid-frame ({got}/{n} bytes)"
+                ) from None
+            raise
+        if not chunk:
+            raise FrameError(f"connection closed mid-frame ({got}/{n} bytes)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)

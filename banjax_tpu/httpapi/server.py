@@ -105,6 +105,9 @@ class ServerDeps:
     # (obs/flightrec.py) — both optional, both primary-owned
     slo_getter: Optional[Callable[[], object]] = None
     flightrec_getter: Optional[Callable[[], object]] = None
+    # decision-fabric counters (fabric/stats.py FabricStats) — None when
+    # the fabric is off
+    fabric_getter: Optional[Callable[[], object]] = None
 
 
 _STANDALONE_KEY = "banjax_standalone_hdrs"
@@ -510,6 +513,7 @@ def build_app(deps: ServerDeps,
             flightrec=(
                 deps.flightrec_getter() if deps.flightrec_getter else None
             ),
+            fabric=deps.fabric_getter() if deps.fabric_getter else None,
         )
         return web.Response(
             text=text,

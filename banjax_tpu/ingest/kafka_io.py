@@ -10,8 +10,11 @@ Reference behavior: /root/reference/internal/kafka.go —
     block_session_ttl_seconds and vice versa (kafka.go:176-192), preserved
     here verbatim;
   * Writer: drains the report queue (drop-don't-block producer side, see
-    banjax_tpu/ingest/reports.py) into the report topic, reconnecting with
-    5 s backoff on failure.
+    banjax_tpu/ingest/reports.py) into the report topic, reconnecting on
+    failure.  The reference's flat 5 s reconnect clocks are replaced on
+    both loops by the shared capped jittered backoff
+    (resilience/backoff.reconnect_backoff — the same implementation the
+    tailer and the fabric peer links use).
 
 Transport: pluggable `KafkaTransport` interface. The default is the real
 broker client — `banjax_tpu.ingest.kafka_wire.WireKafkaTransport`, a pure-
@@ -40,19 +43,19 @@ from banjax_tpu.decisions.model import Decision
 from banjax_tpu.ingest.reports import get_message_queue
 from banjax_tpu.obs import provenance
 from banjax_tpu.resilience import failpoints
-from banjax_tpu.resilience.backoff import Backoff
+from banjax_tpu.resilience.backoff import Backoff, reconnect_backoff
 from banjax_tpu.resilience.health import ComponentHealth
 
 log = logging.getLogger(__name__)
 
-RECONNECT_SECONDS = 5  # kafka.go:169 — now the backoff CAP, not a fixed sleep
+RECONNECT_SECONDS = 5  # kafka.go:169 — now a backoff-CAP input, not a fixed sleep
 
 
 def _reconnect_backoff() -> Backoff:
-    """Reconnects start fast (a transient blip recovers in ~½ s) and cap at
-    6x the reference's flat 5 s clock, with jitter so a fleet sharing a dead
-    broker doesn't stampede it in lockstep."""
-    return Backoff(base=0.5, cap=6 * RECONNECT_SECONDS, jitter=0.5)
+    """The shared reconnect policy (resilience/backoff.reconnect_backoff
+    — one implementation for kafka, the tailer, and fabric peers),
+    capped at 6x the reference's flat 5 s clock."""
+    return reconnect_backoff(cap=6 * RECONNECT_SECONDS)
 
 
 def get_dnet_partition(config: Config) -> int:

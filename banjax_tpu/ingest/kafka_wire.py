@@ -952,8 +952,9 @@ class WireKafkaTransport:
 
     read_messages is a generator that yields message values from the pinned
     partition starting at the LATEST offset; any failure raises
-    KafkaWireError so KafkaReader's reconnect loop (5 s backoff,
-    kafka.go:169) takes over. send round-robins the report topic's
+    KafkaWireError so KafkaReader's reconnect loop (the shared capped
+    jittered backoff, resilience/backoff.reconnect_backoff) takes
+    over. send round-robins the report topic's
     partitions with acks=1; failures raise and the message is dropped —
     the reference's drop-don't-block producer semantics."""
 

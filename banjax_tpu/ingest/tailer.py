@@ -34,7 +34,7 @@ import threading
 from typing import Callable, List, Optional
 
 from banjax_tpu.resilience import failpoints
-from banjax_tpu.resilience.backoff import Backoff
+from banjax_tpu.resilience.backoff import Backoff, reconnect_backoff
 from banjax_tpu.resilience.health import ComponentHealth
 
 log = logging.getLogger(__name__)
@@ -61,7 +61,10 @@ class LogTailer:
                  health: Optional[ComponentHealth] = None):
         self.path = path
         self.on_lines = on_lines
-        self.backoff = backoff or Backoff(base=0.25, cap=RETRY_SECONDS, jitter=0.5)
+        # shared reconnect policy (same implementation as kafka + fabric)
+        self.backoff = backoff or reconnect_backoff(
+            cap=RETRY_SECONDS, base=0.25
+        )
         self.health = health
         # set once the log file is open and being followed (readiness
         # signal for tests and supervisors; re-set after each reopen)

@@ -105,6 +105,7 @@ def render_prometheus(
     supervisor=None,
     slo=None,
     flightrec=None,
+    fabric=None,
 ) -> str:
     """Render the full /metrics payload.  Args mirror
     obs.metrics.write_metrics_line — same sources, non-destructive
@@ -129,6 +130,8 @@ def render_prometheus(
         values["KafkaSkippedBatches"] = kafka_wire.skipped_batch_count()
     except Exception:  # noqa: BLE001 — exposition must not require kafka
         values["KafkaSkippedBatches"] = 0
+    if fabric is not None:
+        values.update(fabric.peek())
     if supervisor is not None:
         values["HttpWorkers"] = supervisor.n_workers
         values["HttpWorkerRespawns"] = supervisor.respawn_count
@@ -237,6 +240,19 @@ def render_prometheus(
                 if field in row:
                     w.sample(registry.PROM_FAMILIES[fam_name],
                              row[field], {"scenario": name})
+
+    # multi-host fabric: per-peer liveness gauge + takeover duration
+    # histogram (banjax_tpu/fabric/stats.py; scalar totals merged above)
+    if fabric is not None:
+        peers = fabric.peers_snapshot()
+        if peers:
+            fam = registry.PROM_FAMILIES["banjax_fabric_peer_up"]
+            for pid, up in sorted(peers.items()):
+                w.sample(fam, 1 if up else 0, {"peer": pid})
+        w.histogram(
+            registry.PROM_FAMILIES["banjax_fabric_takeover_duration_seconds"],
+            fabric.takeover_duration,
+        )
 
     # component health: aggregate + one labeled gauge per component
     if health is not None:

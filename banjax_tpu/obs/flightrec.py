@@ -15,6 +15,8 @@ an incident bundle to ``flightrec_dir``:
         traffic.json      traffic-sketch snapshot (obs/sketch.py): top-K
                           heavy hitters, distinct-IP estimate, per-rule
                           pressure — what the flood looked like
+        fabric.json       decision-fabric snapshot (when fabric_enabled):
+                          peer table, hash-range ownership, last takeover
         provenance.json   last N decision-provenance records
         meta.json         reason, detail, timestamps, config hash,
                           health snapshot, SLO burn state
@@ -66,6 +68,7 @@ class FlightRecorder:
         health=None,
         slo_getter: Optional[Callable[[], object]] = None,
         traffic_fn: Optional[Callable[[], Optional[dict]]] = None,
+        fabric_fn: Optional[Callable[[], Optional[dict]]] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.directory = directory
@@ -77,6 +80,7 @@ class FlightRecorder:
         self._health = health
         self._slo_getter = slo_getter
         self._traffic_fn = traffic_fn
+        self._fabric_fn = fabric_fn
         self._clock = clock
         self._lock = threading.Lock()
         self._last_capture = float("-inf")
@@ -133,6 +137,19 @@ class FlightRecorder:
             traffic if traffic is not None else {"enabled": False},
             indent=1,
         )
+        # fabric snapshot (fabric/router.describe): peer table, hash-
+        # range ownership, last takeover — a shard-failure capture is
+        # self-describing without asking the survivors
+        if self._fabric_fn is not None:
+            fabric: Optional[dict] = None
+            try:
+                fabric = self._fabric_fn()
+            except Exception as e:  # noqa: BLE001 — partial bundle beats none
+                fabric = {"enabled": False, "error": str(e)}
+            files["fabric.json"] = json.dumps(
+                fabric if fabric is not None else {"enabled": False},
+                indent=1, default=str,
+            )
         files["provenance.json"] = json.dumps(
             {
                 "records": provenance.get_ledger().tail(self.provenance_tail),

@@ -53,6 +53,7 @@ def write_metrics_line(
     supervisor=None,
     health=None,
     pipeline=None,
+    fabric=None,
 ) -> None:
     challenges, blocks = dynamic_lists.metrics()
     line = {
@@ -72,6 +73,10 @@ def write_metrics_line(
         # streaming pipeline scheduler: per-stage EWMA latencies, queue
         # depths, shed/stale counters (banjax_tpu/pipeline/scheduler.py)
         line.update(pipeline.snapshot())
+    if fabric is not None:
+        # multi-host decision fabric: routed/forwarded/shed line counts,
+        # replication + takeover counters (banjax_tpu/fabric/stats.py)
+        line.update(fabric.peek())
     # Kafka batches skipped for an undecodable codec (lz4/zstd — VERDICT
     # C17): surfaced only when nonzero so the reference's exact key set is
     # preserved on clean streams
@@ -110,6 +115,7 @@ class MetricsReporter:
         supervisor_getter: Optional[Callable[[], object]] = None,
         health=None,
         pipeline_getter: Optional[Callable[[], object]] = None,
+        fabric_getter: Optional[Callable[[], object]] = None,
     ):
         self.log_path = log_path
         self.dynamic_lists = dynamic_lists
@@ -121,6 +127,7 @@ class MetricsReporter:
         self.supervisor_getter = supervisor_getter
         self.health = health
         self.pipeline_getter = pipeline_getter
+        self.fabric_getter = fabric_getter
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -143,8 +150,11 @@ class MetricsReporter:
                 pipeline = (
                     self.pipeline_getter() if self.pipeline_getter else None
                 )
+                fabric = (
+                    self.fabric_getter() if self.fabric_getter else None
+                )
                 write_metrics_line(
                     out, self.dynamic_lists, self.regex_states,
                     self.failed_challenge_states, matcher, supervisor,
-                    self.health, pipeline,
+                    self.health, pipeline, fabric,
                 )

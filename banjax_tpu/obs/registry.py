@@ -280,6 +280,49 @@ FAMILIES: List[Family] = [
     Family(COUNTER, "fired (line, rule) window events folded into the "
            "sketch, per rule — which rules absorb the flood",
            prom="banjax_traffic_rule_pressure", labels=("rule",)),
+    # ---- multi-host decision fabric (banjax_tpu/fabric/) ----
+    Family(GAUGE, "1 when the labeled fabric peer is alive in this "
+           "node's membership view, 0 after it is declared dead",
+           prom="banjax_fabric_peer_up", labels=("peer",)),
+    Family(COUNTER, "lines forwarded to an owning peer and acked",
+           line_key="FabricForwardedLines",
+           prom="banjax_fabric_forwarded_lines_total"),
+    Family(COUNTER, "lines received over the wire from a fabric peer",
+           line_key="FabricReceivedLines",
+           prom="banjax_fabric_received_lines_total"),
+    Family(COUNTER, "lines owned locally and submitted in-process",
+           line_key="FabricLocalLines",
+           prom="banjax_fabric_local_lines_total"),
+    Family(COUNTER, "lines with no alive owner — counted shed, never "
+           "silently lost (the fabric half of admitted == processed + "
+           "shed)", line_key="FabricShedLines",
+           prom="banjax_fabric_shed_lines_total"),
+    Family(COUNTER, "journal lines replayed to takeover successors "
+           "after a peer death",
+           line_key="FabricReplayedLines",
+           prom="banjax_fabric_replayed_lines_total"),
+    Family(COUNTER, "decisions produced to the Kafka command topic for "
+           "fabric-wide replication",
+           line_key="FabricReplicatedDecisions",
+           prom="banjax_fabric_replicated_decisions_total"),
+    Family(COUNTER, "replication produce attempts that failed (retried "
+           "once, then counted and dropped — the local decision holds)",
+           line_key="FabricReplicationErrors",
+           prom="banjax_fabric_replication_errors_total"),
+    Family(COUNTER, "replicated commands suppressed by the (origin, seq) "
+           "deduper — own-origin echoes and duplicate inserts",
+           line_key="FabricDuplicatesSuppressed",
+           prom="banjax_fabric_duplicate_suppressed_total"),
+    Family(COUNTER, "replicated peer decisions applied to the local "
+           "dynamic lists",
+           line_key="FabricReplicatedApplied",
+           prom="banjax_fabric_replicated_applied_total"),
+    Family(COUNTER, "range takeovers completed after a peer death",
+           line_key="FabricTakeovers",
+           prom="banjax_fabric_takeovers_total"),
+    Family(HISTOGRAM, "takeover duration: peer declared dead -> journal "
+           "fully replayed (s)",
+           prom="banjax_fabric_takeover_duration_seconds"),
     # ---- pipeline scheduler ----
     Family(COUNTER, "lines+commands admitted into the pipeline",
            line_key="PipelineAdmittedLines",

@@ -20,6 +20,22 @@ import threading
 from typing import Callable, Optional
 
 
+def reconnect_backoff(
+    cap: float = 30.0,
+    base: float = 0.5,
+    rng: Optional[random.Random] = None,
+    sleep: Optional[Callable[[float], bool]] = None,
+) -> "Backoff":
+    """The ONE reconnect policy every retry loop shares — kafka reader/
+    writer, log tailer, fabric peer sockets.  Half-jittered exponential
+    from `base` to `cap`; callers tune only the cap (how stale a dead
+    endpoint may go) so a fleet never synchronizes its reconnects the
+    way the reference's flat 5 s clocks (kafka.go:169,
+    regex_rate_limiter.go:47) would."""
+    return Backoff(base=base, cap=cap, factor=2.0, jitter=0.5,
+                   rng=rng, sleep=sleep)
+
+
 class Backoff:
     """Per-loop backoff state (not thread-safe across loops: each
     reconnect loop owns its own instance)."""

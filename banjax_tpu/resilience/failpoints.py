@@ -33,6 +33,13 @@ Instrumented sites in this tree (KNOWN_SITES):
   pipeline.collect — pipeline scheduler, device collect boundary (same)
   pipeline.drain   — pipeline scheduler, drain-stage boundary (the batch's
                      lines are counted as shed, never silently lost)
+  fabric.send      — fabric PeerClient, before every peer send attempt
+                     (retried on the shared reconnect backoff; exhausting
+                     the budget raises PeerUnavailable -> takeover)
+  fabric.recv      — fabric node frame-read path (an injected fault drops
+                     the connection like a torn network)
+  fabric.takeover  — fabric router takeover entry (the takeover completes
+                     anyway; the episode is visible in snapshot())
 """
 
 from __future__ import annotations
@@ -61,6 +68,9 @@ KNOWN_SITES = (
     "pipeline.submit",
     "pipeline.collect",
     "pipeline.drain",
+    "fabric.send",
+    "fabric.recv",
+    "fabric.takeover",
 )
 
 MODES = ("error", "sleep")

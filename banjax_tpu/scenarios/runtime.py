@@ -97,6 +97,78 @@ class RecordingBanner:
 
 
 @dataclasses.dataclass
+class EngineParts:
+    """One assembled single-process engine stack — the unit the fabric
+    replicates per shard.  Built by `build_engine` and shared between
+    ScenarioRunner and fabric/worker so both drive the SAME assembly
+    (matcher flags, scheduler knobs, pinned virtual clock)."""
+
+    cfg: object
+    banner: object
+    dynamic_lists: DynamicDecisionLists
+    regex_states: RegexRateLimitStates
+    matcher: object
+    sched: object
+
+
+def build_engine(
+    rules_yaml: str,
+    *,
+    banner=None,
+    single_kernel: str = "auto",
+    breaker_recovery_s: float = 0.5,
+    latency_budget_ms: float = 180.0,
+    buffer_lines: int = 131072,
+    max_block_ms: float = 50.0,
+    kafka_broker_port: Optional[int] = None,
+    kafka_command_topic: str = "scenario.commands",
+    kafka_report_topic: str = "scenario.reports",
+    cfg_overrides: Optional[Dict[str, object]] = None,
+    now_fn=None,
+) -> EngineParts:
+    """Assemble the full engine (TPU matcher with device windows +
+    pipeline scheduler) on the scenario virtual clock.  The banner is
+    injectable so the fabric can wrap RecordingBanner with its
+    replicating banner without re-stating the assembly."""
+    from banjax_tpu.matcher.runner import TpuMatcher
+    from banjax_tpu.pipeline import PipelineScheduler
+
+    cfg = config_from_yaml_text(rules_yaml)
+    cfg.matcher = "tpu"
+    cfg.matcher_device_windows = True
+    cfg.pallas_single_kernel = single_kernel
+    cfg.breaker_recovery_seconds = breaker_recovery_s
+    cfg.expiring_decision_ttl_seconds = 300
+    if kafka_broker_port is not None:
+        cfg.kafka_brokers = [f"127.0.0.1:{kafka_broker_port}"]
+        cfg.kafka_command_topic = kafka_command_topic
+        cfg.kafka_report_topic = kafka_report_topic
+        cfg.kafka_max_wait_ms = 100
+    if cfg_overrides:
+        # harness-level knobs (slot admission, warm tier, fabric ids,
+        # ...) the scenario's rules_yaml doesn't carry
+        for k, v in cfg_overrides.items():
+            setattr(cfg, k, v)
+    dynamic_lists = DynamicDecisionLists(start_sweeper=False)
+    banner = banner if banner is not None else RecordingBanner()
+    regex_states = RegexRateLimitStates()
+    matcher = TpuMatcher(
+        cfg, banner, StaticDecisionLists(cfg), regex_states
+    )
+    sched = PipelineScheduler(
+        lambda: matcher,
+        latency_budget_ms=latency_budget_ms,
+        buffer_lines=buffer_lines,
+        max_block_ms=max_block_ms,
+        now_fn=now_fn if now_fn is not None else (lambda: RUN_NOW),
+    )
+    return EngineParts(
+        cfg=cfg, banner=banner, dynamic_lists=dynamic_lists,
+        regex_states=regex_states, matcher=matcher, sched=sched,
+    )
+
+
+@dataclasses.dataclass
 class ScenarioReport:
     name: str
     seed: int
@@ -178,40 +250,27 @@ class ScenarioRunner:
     # ---- engine assembly ----
 
     def _build(self):
-        from banjax_tpu.matcher.runner import TpuMatcher
         from banjax_tpu.obs.slo import SloEngine
-        from banjax_tpu.pipeline import PipelineScheduler
 
-        cfg = config_from_yaml_text(self.scenario.rules_yaml)
-        cfg.matcher = "tpu"
-        cfg.matcher_device_windows = True
-        cfg.pallas_single_kernel = self.single_kernel
-        cfg.breaker_recovery_seconds = self.breaker_recovery_s
-        cfg.expiring_decision_ttl_seconds = 300
-        if self.kafka_broker is not None:
-            cfg.kafka_brokers = [f"127.0.0.1:{self.kafka_broker.port}"]
-            cfg.kafka_command_topic = "scenario.commands"
-            cfg.kafka_report_topic = "scenario.reports"
-            cfg.kafka_max_wait_ms = 100
-        if self.cfg_overrides:
-            # harness-level knobs (slot admission, warm tier, ...) the
-            # scenario's rules_yaml doesn't carry
-            for k, v in self.cfg_overrides.items():
-                setattr(cfg, k, v)
-        self.cfg = cfg
-        self.dynamic_lists = DynamicDecisionLists(start_sweeper=False)
-        self.banner = RecordingBanner()
-        self.regex_states = RegexRateLimitStates()
-        self.matcher = TpuMatcher(
-            cfg, self.banner, StaticDecisionLists(cfg), self.regex_states
-        )
-        self.sched = PipelineScheduler(
-            lambda: self.matcher,
+        parts = build_engine(
+            self.scenario.rules_yaml,
+            single_kernel=self.single_kernel,
+            breaker_recovery_s=self.breaker_recovery_s,
             latency_budget_ms=self.latency_budget_ms,
             buffer_lines=self.buffer_lines,
             max_block_ms=self.max_block_ms,
-            now_fn=lambda: RUN_NOW,
+            kafka_broker_port=(
+                self.kafka_broker.port
+                if self.kafka_broker is not None else None
+            ),
+            cfg_overrides=self.cfg_overrides,
         )
+        self.cfg = parts.cfg
+        self.dynamic_lists = parts.dynamic_lists
+        self.banner = parts.banner
+        self.regex_states = parts.regex_states
+        self.matcher = parts.matcher
+        self.sched = parts.sched
         self._vnow = 0.0
         self.slo = SloEngine(
             matcher_getter=lambda: self.matcher,

@@ -101,6 +101,14 @@ refusals, sketch admissions + FP rate, warm-tier spill/refill — into
 BENCH_mega_state.json.  Acceptance (ISSUE 14): p/r 1.0 both rows and
 the admission-on row's lines/s >= the admission-off row's.  Knobs:
 BENCH_MEGA_{DISTINCT,CHUNK,SEED,CAPACITY,SKETCH_WIDTH}, BENCH_CPU=1.
+
+Fabric mode: `bench.py --fabric` — the multi-host decision fabric
+scaling run (banjax_tpu/fabric/harness.py): one dryrun episode per
+shard count (N=1 baseline; N=2 and N=4 with one shard SIGKILLed
+mid-flood and consistent-hash takeover), banking per-N lines/s plus
+the takeover-window shed ratio into BENCH_fabric.json.  Every row is
+recall-gated at 1.0 vs the oracle.  Knobs:
+BENCH_FABRIC_{SHAPE,SEED,SCALE,NS}.
 """
 
 from __future__ import annotations
@@ -1817,6 +1825,92 @@ def _mega_state_mode() -> None:
     print(json.dumps({"metric": book["metric"], **book["summary"]}))
 
 
+FABRIC_PATH = os.path.join(_DIR, "BENCH_fabric.json")
+
+
+def _fabric_mode() -> None:
+    """`bench.py --fabric`: the multi-host decision fabric scaling run.
+
+    One dryrun episode per shard count — N=1 (no kill: the single-shard
+    baseline, every line local), N=2 and N=4 (one shard SIGKILLed
+    mid-flood, consistent-hash takeover) — over the same seeded scenario
+    stream, banking lines/s per N plus the takeover-window shed ratio
+    (lines shed between the kill and the successors finishing the
+    journal replay, over lines fed in that window).  Every row must hold
+    recall 1.0 vs the oracle; the kill rows must also prove the takeover
+    happened and duplicates were suppressed.  Knobs:
+    BENCH_FABRIC_{SHAPE,SEED,SCALE,NS}, BENCH_CPU=1 (workers always pin
+    the CPU backend themselves)."""
+    from banjax_tpu.fabric.harness import run_fabric
+
+    shape = os.environ.get("BENCH_FABRIC_SHAPE", "flash_crowd")
+    seed = int(os.environ.get("BENCH_FABRIC_SEED", "20260804"))
+    scale = float(os.environ.get("BENCH_FABRIC_SCALE", "1.0"))
+    ns = [
+        int(n)
+        for n in os.environ.get("BENCH_FABRIC_NS", "1,2,4").split(",")
+    ]
+
+    rows = {}
+    for n in ns:
+        kill = n > 1
+        report = run_fabric(
+            n_workers=n, shape=shape, seed=seed, scale=scale, kill=kill,
+        )
+        bad = [k for k, ok in report["invariants"].items() if not ok]
+        assert not bad, f"fabric invariants failed at n={n}: {bad}"
+        takeover = report.get("takeover") or {}
+        rows[f"n{n}"] = {
+            "n_workers": n,
+            "killed": report["killed"],
+            "lines": report["n_lines"],
+            "feed_s": report["feed_s"],
+            "lines_per_sec": report["lines_per_sec"],
+            "engine_bans": report["engine_bans"],
+            "oracle_bans": report["oracle_bans"],
+            "precision": report["precision"],
+            "recall": report["recall"],
+            "duplicates_suppressed": report["duplicates_suppressed"],
+            "takeover_window_s": takeover.get("window_s"),
+            "takeover_shed_ratio": takeover.get("shed_ratio_in_window"),
+            "takeover_replayed_lines": (
+                takeover.get("driver_replayed_lines")
+            ),
+        }
+        print(json.dumps({"arm": f"n{n}", **rows[f"n{n}"]}), flush=True)
+
+    kill_rows = [r for r in rows.values() if r["killed"]]
+    book = {
+        "metric": (
+            "decision fabric: lines/s vs shard count with one shard "
+            "SIGKILLed mid-flood (N>1), recall gated at 1.0"
+        ),
+        "shape": shape,
+        "seed": seed,
+        "scale": scale,
+        "measured_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "rows": rows,
+        "summary": {
+            "recall_one_all_rows": all(
+                r["recall"] == 1.0 for r in rows.values()
+            ),
+            "max_takeover_shed_ratio": max(
+                (r["takeover_shed_ratio"] or 0.0) for r in kill_rows
+            ) if kill_rows else None,
+            "max_takeover_window_s": max(
+                (r["takeover_window_s"] or 0.0) for r in kill_rows
+            ) if kill_rows else None,
+        },
+    }
+    tmp = FABRIC_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(book, f, indent=1)
+    os.replace(tmp, FABRIC_PATH)
+    print(json.dumps({"metric": book["metric"], **book["summary"]}))
+
+
 def _single_kernel_mode() -> None:
     """`bench.py --single-kernel`: the streaming pipeline + device
     windows with the single-kernel fused program ON (one dispatch, one
@@ -2233,6 +2327,9 @@ def main() -> None:
         return
     if "--mega-state" in sys.argv:
         _mega_state_mode()
+        return
+    if "--fabric" in sys.argv:
+        _fabric_mode()
         return
     if "--scenarios" in sys.argv:
         _scenarios_mode()

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Multi-host decision fabric dry run: N real banjax worker PROCESSES on
+# real sockets (one box), one shard SIGKILLed mid-flood, consistent-hash
+# takeover + snapshot-sync rejoin — the fabric analogue of the
+# dryrun_multichip device harness (__graft_entry__.dryrun_fabric).
+#
+# Usage: scripts/dryrun_fabric.sh [N]      (default N=2, ~30 s)
+#
+# Every worker is pinned to the CPU backend (a dry-run shard must never
+# grab a real accelerator out from under the host); the short N=2 pass
+# is tier-1 (tests/soak/test_fabric_soak.py), the N=4 chaos pass rides
+# behind `-m slow`.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N="${1:-2}"
+exec env JAX_PLATFORMS=cpu python -c "
+import __graft_entry__ as g
+g.dryrun_fabric(${N})
+"

@@ -1,0 +1,222 @@
+"""Fabric under injected faults (ISSUE 15 satellite): peer send/recv
+faults ride the shared reconnect backoff to recovery, an armed takeover
+failpoint cannot stop a takeover, and the full multi-process harness
+proves the SIGKILL story — takeover recall 1.0, fabric-wide accounting,
+rejoin handback without double-processing."""
+
+import threading
+
+import pytest
+
+from banjax_tpu.fabric import wire
+from banjax_tpu.fabric.hashring import ConsistentHashRing
+from banjax_tpu.fabric.node import FabricNode
+from banjax_tpu.fabric.peer import PeerClient, PeerUnavailable
+from banjax_tpu.fabric.router import FabricRouter
+from banjax_tpu.fabric.stats import FabricStats
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.resilience.backoff import reconnect_backoff
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+
+
+def _recording_backoff(delays):
+    return reconnect_backoff(
+        cap=0.2, base=0.01, sleep=lambda d: delays.append(d) or False
+    )
+
+
+def _echo_node():
+    return FabricNode("127.0.0.1", 0, handlers={
+        wire.T_PING: lambda p: (wire.T_PONG, {}),
+    }).start()
+
+
+def test_send_fault_backs_off_then_reconnects():
+    """fabric.send armed for 2 fires: the first two attempts fault, the
+    backoff waits between tries, the third succeeds — same capped
+    jittered policy as the kafka/tailer loops."""
+    node = _echo_node()
+    delays = []
+    client = PeerClient(
+        "p", "127.0.0.1", node.port, send_timeout_ms=500,
+        max_attempts=3, backoff=_recording_backoff(delays),
+    )
+    try:
+        failpoints.arm("fabric.send", count=2)
+        rtype, _ = client.request(wire.T_PING, {})
+        assert rtype == wire.T_PONG
+        assert failpoints.fired_count("fabric.send") == 2
+        assert len(delays) == 2          # one backoff wait per failed try
+        assert delays[1] > 0             # exponential: still positive
+        # recovery resets the policy: next request is first-try clean
+        delays.clear()
+        client.request(wire.T_PING, {})
+        assert delays == []
+    finally:
+        client.close()
+        node.stop()
+
+
+def test_recv_fault_tears_connection_then_client_recovers():
+    """fabric.recv armed once: the node drops the connection exactly
+    like a torn network; the client's next attempt reconnects and
+    completes inside the same request() call."""
+    node = _echo_node()
+    delays = []
+    client = PeerClient(
+        "p", "127.0.0.1", node.port, send_timeout_ms=500,
+        max_attempts=3, backoff=_recording_backoff(delays),
+    )
+    try:
+        client.request(wire.T_PING, {})  # warm connection established
+        failpoints.arm("fabric.recv", count=1)
+        rtype, _ = client.request(wire.T_PING, {})
+        assert rtype == wire.T_PONG
+        assert failpoints.fired_count("fabric.recv") == 1
+        assert len(delays) >= 1          # the retry waited before reconnect
+    finally:
+        client.close()
+        node.stop()
+
+
+def test_send_fault_exhausting_budget_raises_peer_unavailable():
+    node = _echo_node()
+    client = PeerClient(
+        "p", "127.0.0.1", node.port, send_timeout_ms=500,
+        max_attempts=2, backoff=_recording_backoff([]),
+    )
+    try:
+        failpoints.arm("fabric.send")    # unlimited: every attempt faults
+        with pytest.raises(PeerUnavailable):
+            client.request(wire.T_PING, {})
+    finally:
+        client.close()
+        node.stop()
+
+
+def test_takeover_fault_cannot_stop_the_takeover():
+    """fabric.takeover armed: the failpoint fires inside mark_dead but
+    the takeover must still complete — range moved, journal replayed,
+    counters bumped.  Losing a takeover would orphan a keyspace range."""
+    ring = ConsistentHashRing(["w0", "w1"], vnodes=64)
+
+    class _DeadPeer:
+        peer_id, host, port = "w1", "127.0.0.1", 0
+        breaker = type("B", (), {"state": "open"})()
+
+        def request(self, ftype, payload):
+            raise PeerUnavailable("w1 gone")
+
+        def connect_to(self, host, port):
+            pass
+
+    local = []
+    stats = FabricStats()
+    router = FabricRouter(
+        "w0", ring, {"w0": None, "w1": _DeadPeer()},
+        lambda ls: local.extend(ls) or len(ls),
+        stats=stats, takeover_grace_ms=0.0,
+    )
+    # seed w1's journal so the takeover has something to replay
+    lines = [f"1000.0 10.2.{i >> 8}.{i & 255} GET h GET / HTTP/1.1 ua -"
+             for i in range(256)]
+    # force-journal through routing while w1 still answers
+    held = []
+
+    class _LivePeer(_DeadPeer):
+        def request(self, ftype, payload):
+            held.extend(payload["lines"])
+            return wire.T_ACK, {}
+
+    router.peers["w1"] = _LivePeer()
+    router.route(lines)
+    assert held
+    router.peers["w1"] = _DeadPeer()
+    failpoints.arm("fabric.takeover", count=1)
+    router.mark_dead("w1", reason="chaos")
+    assert failpoints.fired_count("fabric.takeover") == 1
+    peek = stats.peek()
+    assert peek["FabricTakeovers"] == 1
+    assert peek["FabricReplayedLines"] == len(held)
+    assert set(held) <= set(local)       # sole survivor re-derived all
+    assert "w1" not in router.alive
+
+
+def test_breaker_open_fails_fast_without_socket_attempts():
+    """A dead peer's breaker opens after the retry budget; subsequent
+    requests fail fast (PeerUnavailable) without burning the timeout —
+    the property that keeps a takeover from stalling the feed path."""
+    delays = []
+    client = PeerClient(
+        "ghost", "127.0.0.1", 1, send_timeout_ms=100, max_attempts=2,
+        backoff=_recording_backoff(delays),
+    )
+    for _ in range(2):                   # drive the breaker open
+        with pytest.raises(PeerUnavailable):
+            client.request(wire.T_PING, {})
+    assert not client.breaker.allow()
+    n_delays = len(delays)
+    with pytest.raises(PeerUnavailable, match="breaker"):
+        client.request(wire.T_PING, {})
+    assert len(delays) == n_delays       # no new connect/backoff burned
+
+
+def test_node_survives_oversized_frame_without_desync():
+    """A sabotage-sized frame fails that connection loudly; the node
+    keeps serving fresh connections."""
+    import socket as _socket
+
+    node = _echo_node()
+    try:
+        raw = _socket.create_connection(("127.0.0.1", node.port), 1.0)
+        raw.sendall(wire._HEADER.pack(wire.MAX_FRAME_BYTES + 1, wire.T_PING))
+        raw.close()
+        client = PeerClient("p", "127.0.0.1", node.port,
+                            send_timeout_ms=500)
+        try:
+            assert client.request(wire.T_PING, {})[0] == wire.T_PONG
+        finally:
+            client.close()
+    finally:
+        node.stop()
+
+
+def test_sigkill_mid_scenario_takeover_and_rejoin_handback():
+    """The full fault story through REAL processes (reduced scale; the
+    scale-1.0 pass lives in tests/soak/test_fabric_soak.py): SIGKILL a
+    shard mid-scenario → successor takeover with recall 1.0 and the
+    fabric-wide admitted == processed + shed ledger, then rejoin →
+    range handback without double-processing."""
+    from banjax_tpu.fabric.harness import run_fabric
+
+    report = run_fabric(
+        n_workers=2, shape="flash_crowd", seed=20260804, scale=0.5,
+        kill=True, rejoin=True,
+    )
+    bad = [k for k, ok in report["invariants"].items() if not ok]
+    bad += [
+        f"rejoin.{k}"
+        for k, ok in report["rejoin"]["invariants"].items() if not ok
+    ]
+    assert not bad, f"{bad}\n{report}"
+    assert report["recall"] == 1.0 and report["oracle_bans"] > 0
+    assert report["fed_lines"] == report["acked_lines"]
+    assert report["takeover"]["victim"] == report["killed"] == "w1"
+    assert report["rejoin"]["invariants"]["wave_exactly_once"]
+
+
+def test_client_stop_event_short_circuits_retries():
+    stop = threading.Event()
+    stop.set()
+    client = PeerClient(
+        "ghost", "127.0.0.1", 1, send_timeout_ms=100, max_attempts=3,
+        stop=stop, backoff=_recording_backoff([]),
+    )
+    with pytest.raises(PeerUnavailable):
+        client.request(wire.T_PING, {})

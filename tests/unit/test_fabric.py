@@ -1,0 +1,543 @@
+"""Fabric building blocks: hash ring, wire frames, dedupe/replication,
+router ledger, and the schema-stability contract for every new
+registry family and config key (ISSUE 15)."""
+
+import io
+import json
+import socket
+import threading
+
+import pytest
+
+from banjax_tpu.config.schema import Config, config_from_yaml_text
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.decisions.rate_limit import (
+    FailedChallengeRateLimitStates,
+    RegexRateLimitStates,
+)
+from banjax_tpu.fabric import wire
+from banjax_tpu.fabric.hashring import ConsistentHashRing
+from banjax_tpu.fabric.node import FabricNode
+from banjax_tpu.fabric.peer import PeerClient, PeerUnavailable
+from banjax_tpu.fabric.replication import (
+    DecisionReplicator,
+    FabricDeduper,
+    ReplicatingBanner,
+)
+from banjax_tpu.fabric.router import FabricRouter, ip_of_line
+from banjax_tpu.fabric.stats import FabricStats
+from banjax_tpu.obs import registry
+from banjax_tpu.obs.exposition import parse_text_format, render_prometheus
+from banjax_tpu.obs.metrics import write_metrics_line
+from banjax_tpu.scenarios.shapes import RULES_YAML
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_is_deterministic_across_instances():
+    a = ConsistentHashRing(["w0", "w1", "w2"], vnodes=64)
+    b = ConsistentHashRing(["w2", "w0", "w1"], vnodes=64)  # order-free
+    keys = [f"10.{i >> 8}.{i & 255}.7" for i in range(512)]
+    assert [a.owner(k) for k in keys] == [b.owner(k) for k in keys]
+
+
+def test_ring_partition_covers_every_key_exactly_once():
+    ring = ConsistentHashRing(["w0", "w1", "w2"], vnodes=32)
+    keys = [f"192.0.{i}.1" for i in range(200)]
+    parts = ring.partition(keys)
+    seen = sorted(i for idxs in parts.values() for i in idxs)
+    assert seen == list(range(len(keys)))
+
+
+def test_ring_exclusion_moves_only_the_dead_nodes_keys():
+    """Killing one node hands ONLY its keys to successors; everyone
+    else's ownership is untouched — the zero-reshuffle property the
+    takeover leans on."""
+    ring = ConsistentHashRing(["w0", "w1", "w2"], vnodes=64)
+    keys = [f"172.16.{i >> 8}.{i & 255}" for i in range(1024)]
+    before = {k: ring.owner(k) for k in keys}
+    after = {k: ring.owner(k, alive={"w0", "w1"}) for k in keys}
+    for k in keys:
+        if before[k] != "w2":
+            assert after[k] == before[k], k
+        else:
+            assert after[k] in ("w0", "w1"), k
+    # and a rejoin restores the exact original ownership
+    assert {k: ring.owner(k) for k in keys} == before
+
+
+def test_ring_rejects_degenerate_shapes():
+    with pytest.raises(ValueError):
+        ConsistentHashRing([])
+    with pytest.raises(ValueError):
+        ConsistentHashRing(["w0"], vnodes=0)
+    ring = ConsistentHashRing(["w0", "w1"])
+    with pytest.raises(ValueError):
+        ring.owner("1.2.3.4", alive=set())
+
+
+def test_ring_ownership_fractions_sum_to_one():
+    ring = ConsistentHashRing(["w0", "w1", "w2", "w3"], vnodes=64)
+    fr = ring.ownership_fractions(samples=2048)
+    assert abs(sum(fr.values()) - 1.0) < 1e-9
+    assert set(fr) == {"w0", "w1", "w2", "w3"}
+    # vnodes keep the split roughly even (generous band: hash variance)
+    assert all(0.05 < f < 0.6 for f in fr.values()), fr
+
+
+def test_ip_of_line_extracts_reference_field_two():
+    assert ip_of_line("1722.5 9.9.9.9 GET h GET / HTTP/1.1 ua -") == "9.9.9.9"
+    assert ip_of_line("weird") == "weird"  # degenerate: hash the line
+
+
+# ---------------------------------------------------------------------------
+# wire frames
+# ---------------------------------------------------------------------------
+
+
+def test_wire_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    try:
+        wire.send_frame(a, wire.T_LINES, {"lines": ["x", "y"], "route": True})
+        ftype, payload = wire.recv_frame(b)
+        assert ftype == wire.T_LINES
+        assert payload == {"lines": ["x", "y"], "route": True}
+        wire.send_frame(b, wire.T_ACK, {})
+        assert wire.recv_frame(a) == (wire.T_ACK, {})
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_oversized_and_torn_frames_fail_loudly():
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(wire.FrameError):
+            wire.send_frame(a, wire.T_LINES, {"pad": "x" * wire.MAX_FRAME_BYTES})
+        # oversized length header on the read side
+        a.sendall(wire._HEADER.pack(wire.MAX_FRAME_BYTES + 1, wire.T_LINES))
+        with pytest.raises(wire.FrameError):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+    # torn mid-frame: peer closes after the header
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire._HEADER.pack(100, wire.T_LINES))
+        a.close()
+        with pytest.raises(wire.FrameError):
+            wire.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_wire_non_object_payload_rejected():
+    a, b = socket.socketpair()
+    try:
+        body = json.dumps([1, 2, 3]).encode()
+        a.sendall(wire._HEADER.pack(1 + len(body), wire.T_ACK) + body)
+        with pytest.raises(wire.FrameError):
+            wire.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# replication + dedupe
+# ---------------------------------------------------------------------------
+
+
+class _MemTransport:
+    def __init__(self, fail_times: int = 0):
+        self.sent = []
+        self.fail_times = fail_times
+
+    def send(self, config, topic, value):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise OSError("injected produce failure")
+        assert isinstance(value, bytes)  # the wire transport contract
+        self.sent.append((topic, value))
+
+
+def test_replicator_applies_locally_then_produces_tagged_bytes():
+    applied = []
+    tr = _MemTransport()
+    rep = DecisionReplicator(
+        "w0", tr, "cmds", local_apply=applied.append
+    )
+    rep.publish("9.9.9.9", Decision.NGINX_BLOCK, "site.com")
+    rep.publish("8.8.8.8", Decision.CHALLENGE, "")
+    assert [c["Value"] for c in applied] == ["9.9.9.9", "8.8.8.8"]
+    cmds = [json.loads(v) for _, v in tr.sent]
+    assert [c["Name"] for c in cmds] == ["block_ip", "challenge_ip"]
+    assert all(c["fabric_origin"] == "w0" for c in cmds)
+    assert [c["fabric_seq"] for c in cmds] == [1, 2]  # monotonic
+
+
+def test_replicator_retries_once_then_counts_and_drops():
+    stats = FabricStats()
+    rep = DecisionReplicator(
+        "w0", _MemTransport(fail_times=99), "cmds", stats=stats
+    )
+    rep.publish("9.9.9.9", Decision.NGINX_BLOCK, "d")
+    assert stats.peek()["FabricReplicationErrors"] == 2  # both attempts
+    assert stats.peek()["FabricReplicatedDecisions"] == 0
+
+
+def test_deduper_suppresses_own_origin_and_duplicates():
+    applied = []
+    stats = FabricStats()
+    dd = FabricDeduper("w0", applied.append, stats=stats)
+    own = {"Name": "block_ip", "Value": "1.1.1.1",
+           "fabric_origin": "w0", "fabric_seq": 1}
+    peer = {"Name": "block_ip", "Value": "2.2.2.2",
+            "fabric_origin": "w1", "fabric_seq": 1}
+    untagged = {"Name": "block_ip", "Value": "3.3.3.3"}
+    dd.dispatch(json.dumps(own))
+    dd.dispatch(json.dumps(peer).encode())  # bytes and str both accepted
+    dd.dispatch(json.dumps(peer))           # duplicate (origin, seq)
+    dd.dispatch(json.dumps(untagged))       # operator curl: passthrough
+    dd.dispatch(b"not json")                # must not raise
+    assert [c["Value"] for c in applied] == ["2.2.2.2", "3.3.3.3"]
+    assert stats.peek()["FabricDuplicatesSuppressed"] == 2
+    assert stats.peek()["FabricReplicatedApplied"] == 1
+
+
+def test_deduper_seen_set_is_bounded():
+    dd = FabricDeduper("w0", lambda cmd: None, max_seen=8)
+    for seq in range(64):
+        dd.dispatch(json.dumps(
+            {"Name": "block_ip", "Value": "1.1.1.1",
+             "fabric_origin": "w1", "fabric_seq": seq}
+        ))
+    assert len(dd._seen) == 8
+
+
+def test_replicating_banner_passes_through_and_publishes():
+    class Inner:
+        def __init__(self):
+            self.calls = []
+
+        def ban_or_challenge_ip(self, config, ip, decision, domain):
+            self.calls.append(ip)
+
+        def log_regex_ban(self, *a):
+            return "host-local"
+
+    tr = _MemTransport()
+    inner = Inner()
+    rb = ReplicatingBanner(inner, DecisionReplicator("w0", tr, "cmds"))
+    rb.ban_or_challenge_ip(None, "9.9.9.9", Decision.NGINX_BLOCK, "d")
+    assert inner.calls == ["9.9.9.9"]
+    assert len(tr.sent) == 1
+    assert rb.log_regex_ban() == "host-local"  # __getattr__ delegation
+
+
+# ---------------------------------------------------------------------------
+# router ledger + takeover (fake peers, no sockets)
+# ---------------------------------------------------------------------------
+
+
+class _FakePeer:
+    """Duck-types PeerClient.request; flips to dead on demand."""
+
+    def __init__(self, peer_id):
+        self.peer_id = peer_id
+        self.host, self.port = "127.0.0.1", 0  # describe() reads these
+        self.breaker = type("B", (), {"state": "closed"})()
+        self.lines = []
+        self.dead = False
+
+    def request(self, ftype, payload):
+        if self.dead:
+            raise PeerUnavailable(f"{self.peer_id} dead")
+        self.lines.extend(payload["lines"])
+        return wire.T_ACK, {"n": len(payload["lines"])}
+
+    def connect_to(self, host, port):
+        self.dead = False
+
+
+def _router(n=3, grace_ms=0.0):
+    ids = [f"w{i}" for i in range(n)]
+    ring = ConsistentHashRing(ids, vnodes=64)
+    local = []
+    peers = {
+        wid: (None if wid == "w0" else _FakePeer(wid)) for wid in ids
+    }
+    stats = FabricStats()
+    r = FabricRouter(
+        "w0", ring, peers, lambda ls: local.extend(ls) or len(ls),
+        stats=stats, takeover_grace_ms=grace_ms,
+    )
+    return r, local, peers, stats
+
+
+def _lines(n):
+    return [f"1000.0 10.1.{i >> 8}.{i & 255} GET h GET / HTTP/1.1 ua -"
+            for i in range(n)]
+
+
+def test_router_disposition_sums_to_len_and_matches_ring():
+    r, local, peers, stats = _router()
+    lines = _lines(300)
+    out = r.route(lines)
+    assert out["local"] + out["forwarded"] + out["shed"] == len(lines)
+    assert out["shed"] == 0
+    assert len(local) == out["local"]
+    assert sum(len(p.lines) for p in peers.values() if p) == out["forwarded"]
+    # every line landed where the ring says its IP lives
+    for wid, peer in peers.items():
+        if peer:
+            assert all(
+                r.ring.owner(ip_of_line(ln)) == wid for ln in peer.lines
+            )
+
+
+def test_router_dead_peer_triggers_takeover_and_journal_replay():
+    r, local, peers, stats = _router()
+    first = _lines(200)
+    r.route(first)
+    held_by_w1 = list(peers["w1"].lines)
+    assert held_by_w1  # the scenario must actually exercise w1
+    peers["w1"].dead = True
+    more = _lines(50)
+    out = r.route(more)  # detection happens inside this route call
+    assert out["local"] + out["forwarded"] + out["shed"] == len(more)
+    peek = stats.peek()
+    assert peek["FabricTakeovers"] == 1
+    assert stats.last_takeover["peer"] == "w1"
+    # the whole w1 journal was replayed through routing to survivors
+    assert peek["FabricReplayedLines"] == len(held_by_w1)
+    survivors = set(local) | set(peers["w2"].lines)
+    assert set(held_by_w1) <= survivors  # zero lost lines
+    # ledger: local + forwarded + shed == routed + replayed
+    assert (
+        peek["FabricLocalLines"] + peek["FabricForwardedLines"]
+        + peek["FabricShedLines"]
+        == len(first) + len(more) + peek["FabricReplayedLines"]
+    )
+
+
+def test_router_all_peers_dead_sheds_counted_never_silent():
+    r, local, peers, stats = _router(n=2)
+    peers["w1"].dead = True
+    r.route(_lines(40))
+    # single survivor: everything is local now, nothing shed
+    assert stats.peek()["FabricShedLines"] == 0
+    r.alive.clear()  # no alive owner at all (shutdown race shape)
+    out = r.route(_lines(8))
+    assert out == {"local": 0, "forwarded": 0, "shed": 8}
+    assert stats.peek()["FabricShedLines"] == 8
+
+
+def test_router_mark_alive_is_pure_membership_no_replay():
+    r, local, peers, stats = _router()
+    r.route(_lines(200))
+    peers["w1"].dead = True
+    r.mark_dead("w1", reason="test")
+    replayed_after_takeover = stats.peek()["FabricReplayedLines"]
+    r.mark_alive("w1", host="127.0.0.1", port=1)
+    assert stats.peek()["FabricReplayedLines"] == replayed_after_takeover
+    assert "w1" in r.alive
+    d = r.describe()
+    assert d["peers"]["w1"]["alive"] is True
+    assert d["last_takeover"]["peer"] == "w1"
+
+
+# ---------------------------------------------------------------------------
+# node <-> peer over real sockets
+# ---------------------------------------------------------------------------
+
+
+def test_node_peer_request_response_and_t_err():
+    got = []
+
+    def h_lines(payload):
+        got.extend(payload["lines"])
+        return wire.T_ACK, {"n": len(payload["lines"])}
+
+    def h_boom(payload):
+        raise RuntimeError("handler exploded")
+
+    node = FabricNode("127.0.0.1", 0, handlers={
+        wire.T_LINES: h_lines, wire.T_STATS: h_boom,
+    }).start()
+    client = PeerClient("n", "127.0.0.1", node.port, send_timeout_ms=500)
+    try:
+        rtype, rp = client.request(wire.T_LINES, {"lines": ["a", "b"]})
+        assert (rtype, rp["n"]) == (wire.T_ACK, 2)
+        assert got == ["a", "b"]
+        # handler exception answers T_ERR and keeps the connection
+        with pytest.raises(OSError, match="handler exploded"):
+            client.request(wire.T_STATS, {})
+        # unhandled frame type also answers T_ERR
+        with pytest.raises(OSError, match="unhandled frame type"):
+            client.request(wire.T_SNAPSHOT, {})
+        # connection still fine afterwards
+        rtype, _ = client.request(wire.T_LINES, {"lines": ["c"]})
+        assert rtype == wire.T_ACK
+    finally:
+        client.close()
+        node.stop()
+
+
+def test_peer_unavailable_after_retry_budget_against_dead_port():
+    # bind-then-close: a port with nothing listening
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    client = PeerClient(
+        "ghost", "127.0.0.1", port, send_timeout_ms=100, max_attempts=2,
+        backoff=None,
+    )
+    with pytest.raises(PeerUnavailable):
+        client.request(wire.T_PING, {})
+
+
+# ---------------------------------------------------------------------------
+# schema stability: registry families, line keys, config keys
+# ---------------------------------------------------------------------------
+
+
+def test_fabric_stats_peek_keys_are_all_registry_declared():
+    peek = FabricStats().peek()
+    assert set(peek) == {
+        "FabricForwardedLines", "FabricReceivedLines", "FabricLocalLines",
+        "FabricShedLines", "FabricReplayedLines",
+        "FabricReplicatedDecisions", "FabricReplicationErrors",
+        "FabricDuplicatesSuppressed", "FabricReplicatedApplied",
+        "FabricTakeovers",
+    }
+    for key in peek:
+        assert registry.is_declared_line_key(key), key
+
+
+def test_fabric_prom_families_exist_with_stable_names():
+    expected = {
+        "banjax_fabric_peer_up",
+        "banjax_fabric_forwarded_lines_total",
+        "banjax_fabric_received_lines_total",
+        "banjax_fabric_local_lines_total",
+        "banjax_fabric_shed_lines_total",
+        "banjax_fabric_replayed_lines_total",
+        "banjax_fabric_replicated_decisions_total",
+        "banjax_fabric_replication_errors_total",
+        "banjax_fabric_duplicate_suppressed_total",
+        "banjax_fabric_replicated_applied_total",
+        "banjax_fabric_takeovers_total",
+        "banjax_fabric_takeover_duration_seconds",
+    }
+    assert expected <= set(registry.PROM_FAMILIES), (
+        expected - set(registry.PROM_FAMILIES)
+    )
+
+
+def test_fabric_families_render_on_both_surfaces_and_parse():
+    stats = FabricStats()
+    stats.note_local(5)
+    stats.note_forwarded(3)
+    stats.note_received(2)
+    stats.note_takeover("w9", 0.25, 7)
+    stats.note_peer("w9", False)
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False),
+        RegexRateLimitStates(), FailedChallengeRateLimitStates(),
+        fabric=stats,
+    )
+    fams = parse_text_format(text)
+    undeclared = [f for f in fams if f not in registry.PROM_FAMILIES]
+    assert not undeclared, undeclared
+    scalars = {
+        s[0]: s[2] for ent in fams.values() for s in ent["samples"]
+        if not s[1]
+    }
+    assert scalars["banjax_fabric_local_lines_total"] == 5
+    assert scalars["banjax_fabric_forwarded_lines_total"] == 3
+    assert scalars["banjax_fabric_takeovers_total"] == 1
+    labeled = {
+        (s[0], tuple(sorted(s[1].items()))): s[2]
+        for ent in fams.values() for s in ent["samples"] if s[1]
+    }
+    assert labeled[("banjax_fabric_peer_up", (("peer", "w9"),))] == 0
+    out = io.StringIO()
+    write_metrics_line(
+        out, DynamicDecisionLists(start_sweeper=False),
+        RegexRateLimitStates(), FailedChallengeRateLimitStates(),
+        fabric=stats,
+    )
+    line = json.loads(out.getvalue())
+    assert line["FabricLocalLines"] == 5
+    assert line["FabricTakeovers"] == 1
+
+
+def test_fabric_config_keys_schema_stable():
+    cfg = Config()
+    assert cfg.fabric_enabled is False
+    assert cfg.fabric_node_id == ""
+    assert cfg.fabric_listen == ""
+    assert cfg.fabric_peers == {}
+    assert cfg.fabric_vnodes == 64
+    assert cfg.fabric_send_timeout_ms == 2000.0
+    assert cfg.fabric_takeover_grace_ms == 500.0
+    good = config_from_yaml_text(RULES_YAML + """
+fabric_enabled: true
+fabric_node_id: shard-a
+fabric_listen: 0.0.0.0:4480
+fabric_peers:
+  shard-a: 10.0.0.1:4480
+  shard-b: 10.0.0.2:4480
+fabric_vnodes: 16
+fabric_send_timeout_ms: 750
+fabric_takeover_grace_ms: 100
+""")
+    assert good.fabric_enabled and good.fabric_node_id == "shard-a"
+    assert good.fabric_peers["shard-b"] == "10.0.0.2:4480"
+    assert good.fabric_vnodes == 16
+
+
+def test_flight_recorder_bundle_gains_fabric_json(tmp_path):
+    """Satellite 6: incident bundles capture the fabric snapshot —
+    peer table, hash-range ownership, last takeover — when a fabric_fn
+    is wired (cli passes _fabric_snapshot)."""
+    from banjax_tpu.obs.flightrec import FlightRecorder
+
+    r, local, peers, stats = _router()
+    r.route(_lines(64))
+    peers["w1"].dead = True
+    r.mark_dead("w1", reason="test")
+    rec = FlightRecorder(
+        str(tmp_path / "incidents"), min_interval_s=0.0,
+        fabric_fn=lambda: {"enabled": True, **r.describe(),
+                           "stats": stats.peek()},
+    )
+    name = rec.notify("fabric-takeover", "w1")
+    doc = json.loads(
+        (tmp_path / "incidents" / name / "fabric.json").read_text()
+    )
+    assert doc["enabled"] is True
+    assert doc["peers"]["w1"]["alive"] is False
+    assert doc["last_takeover"]["peer"] == "w1"
+    assert abs(sum(doc["ownership"].values()) - 1.0) < 1e-9
+    assert doc["stats"]["FabricTakeovers"] == 1
+
+
+@pytest.mark.parametrize("snippet, match", [
+    ("fabric_vnodes: 0", "fabric_vnodes"),
+    ("fabric_send_timeout_ms: 0", "fabric_send_timeout_ms"),
+    ("fabric_takeover_grace_ms: -1", "fabric_takeover_grace_ms"),
+    ("fabric_enabled: true", "requires fabric_node_id"),
+    ("fabric_enabled: true\nfabric_node_id: a\n"
+     "fabric_listen: 0.0.0.0:1\nfabric_peers:\n  b: 1.2.3.4:1",
+     "missing this node's own id"),
+])
+def test_fabric_config_validation_errors(snippet, match):
+    with pytest.raises(ValueError, match=match):
+        config_from_yaml_text(RULES_YAML + "\n" + snippet)
