@@ -409,6 +409,21 @@ class Config:
     # drain grace between declaring a peer dead and replaying its line
     # journal to the takeover successors
     fabric_takeover_grace_ms: float = 500.0
+    # SWIM gossip membership (banjax_tpu/fabric/membership.py): probe
+    # one member per interval; <= 0 disables gossip entirely and the
+    # fabric falls back to PR 11's static topology (death discovered
+    # only by a failed forward)
+    fabric_gossip_interval_ms: float = 1000.0
+    # how long a SUSPECT member has to produce liveness evidence (direct
+    # or indirect ack, or a refutation digest) before it is confirmed
+    # DEAD; must exceed the gossip interval when gossip is enabled
+    fabric_suspect_timeout_ms: float = 3000.0
+    # indirect ping-req relays fanned out when a direct probe fails
+    # (0 = suspect immediately on direct-probe failure)
+    fabric_indirect_probes: int = 2
+    # budget for the planned-leave drain (stop owning, flush, announce
+    # LEFT) before the process departs anyway
+    fabric_graceful_leave_ms: float = 5000.0
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -473,6 +488,8 @@ _SCALAR_KEYS = {
     "fabric_enabled": bool, "fabric_node_id": str, "fabric_listen": str,
     "fabric_vnodes": int, "fabric_send_timeout_ms": float,
     "fabric_takeover_grace_ms": float,
+    "fabric_gossip_interval_ms": float, "fabric_suspect_timeout_ms": float,
+    "fabric_indirect_probes": int, "fabric_graceful_leave_ms": float,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -716,6 +733,25 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
                 f"config key fabric_peers: missing this node's own id "
                 f"{cfg.fabric_node_id!r}"
             )
+    if (
+        cfg.fabric_gossip_interval_ms > 0
+        and cfg.fabric_suspect_timeout_ms <= cfg.fabric_gossip_interval_ms
+    ):
+        raise ValueError(
+            "config key fabric_suspect_timeout_ms: must exceed "
+            f"fabric_gossip_interval_ms, got {cfg.fabric_suspect_timeout_ms}"
+            f" <= {cfg.fabric_gossip_interval_ms}"
+        )
+    if cfg.fabric_indirect_probes < 0:
+        raise ValueError(
+            "config key fabric_indirect_probes: expected >= 0, got "
+            f"{cfg.fabric_indirect_probes}"
+        )
+    if cfg.fabric_graceful_leave_ms < 0:
+        raise ValueError(
+            "config key fabric_graceful_leave_ms: expected >= 0, got "
+            f"{cfg.fabric_graceful_leave_ms}"
+        )
     if cfg.flightrec_keep < 1 or cfg.flightrec_provenance_records < 1:
         raise ValueError(
             "config keys flightrec_keep/flightrec_provenance_records: "

@@ -72,6 +72,7 @@ class _Worker:
         self.port: Optional[int] = None
         self.client: Optional[PeerClient] = None
         self.ready_error: Optional[str] = None
+        self.ready_info: Dict[str, object] = {}
 
     def read_ready(self, timeout_s: float) -> None:
         """Block until the worker prints its READY line (post-warmup,
@@ -96,6 +97,7 @@ class _Worker:
                 result.get("error") or f"no READY within {timeout_s}s"
             )
             return
+        self.ready_info = dict(result)
         self.port = int(result["port"])
         self.client = PeerClient(
             self.wid, "127.0.0.1", self.port,
@@ -125,7 +127,8 @@ class _Worker:
             self.client.close()
 
 
-def _spawn(wid: str, broker_port: int, stderr_path: Optional[str]) -> _Worker:
+def _spawn(wid: str, broker_port: int, stderr_path: Optional[str],
+           extra_args: Tuple[str, ...] = ()) -> _Worker:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     if stderr_path:
@@ -135,7 +138,8 @@ def _spawn(wid: str, broker_port: int, stderr_path: Optional[str]) -> _Worker:
         stderr = subprocess.DEVNULL
     proc = subprocess.Popen(
         [sys.executable, "-m", "banjax_tpu.fabric.worker",
-         "--node-id", wid, "--broker-port", str(broker_port)],
+         "--node-id", wid, "--broker-port", str(broker_port),
+         *extra_args],
         stdout=subprocess.PIPE, stderr=stderr, cwd=_REPO, env=env,
     )
     return _Worker(wid, proc)
@@ -153,11 +157,21 @@ class FabricDryrun:
         scale: float = 1.0,
         kill: bool = True,
         rejoin: bool = False,
+        churn: bool = False,
+        gossip_interval_ms: float = 250.0,
+        suspect_timeout_ms: float = 1200.0,
         kill_frac: float = 0.45,
         ready_timeout_s: float = 420.0,
         settle_timeout_s: float = 120.0,
         log_dir: Optional[str] = None,
     ):
+        self.schedule = None
+        if churn:
+            from banjax_tpu.scenarios.chaos import MembershipChurnSchedule
+
+            kill, rejoin = True, False  # churn runs its own join phase
+            self.schedule = MembershipChurnSchedule(seed)
+            kill_frac = self.schedule.kill_frac
         if kill and n_workers < 2:
             raise ValueError("kill needs n_workers >= 2")
         self.n_workers = n_workers
@@ -166,6 +180,9 @@ class FabricDryrun:
         self.scale = scale
         self.kill = kill
         self.rejoin = rejoin
+        self.churn = churn
+        self.gossip_interval_ms = gossip_interval_ms
+        self.suspect_timeout_ms = suspect_timeout_ms
         self.kill_frac = kill_frac
         self.ready_timeout_s = ready_timeout_s
         self.settle_timeout_s = settle_timeout_s
@@ -180,6 +197,8 @@ class FabricDryrun:
         self.fed_lines = 0
         self.acked_lines = 0
         self.takeover: Dict[str, object] = {}
+        # churn mode: per-survivor kill -> gossip-confirmed-dead seconds
+        self.detection: Dict[str, float] = {}
 
     # ---- plumbing ----
 
@@ -221,8 +240,11 @@ class FabricDryrun:
         self.alive.remove(wid)
         t0 = time.perf_counter()
         pre = {w: self._stats(w) for w in self.alive}
-        # survivors replay their forward-journals inside this ack
+        # survivors schedule their forward-journal replays behind the
+        # deadline-polled grace — the ack returns promptly, so wait for
+        # the takeovers to actually complete before auditing the window
         self._broadcast(wire.T_PEER_DOWN, {"peer": wid})
+        self._await_takeovers(wid)
         replayed = 0
         for chunk in self._journal[wid]:
             self._send_chunk(chunk, count_ack=False)
@@ -256,6 +278,28 @@ class FabricDryrun:
             ),
             "window_s": round(time.perf_counter() - t0, 3),
         }
+
+    def _await_takeovers(self, victim: str, timeout_s: float = 60.0) -> None:
+        """Block until every live worker has removed `victim` from its
+        alive set AND completed (not merely scheduled) any pending
+        takeover — mark_dead no longer replays inline."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            done = True
+            for w in self.alive:
+                r = self._stats(w).get("router") or {}
+                if victim in (r.get("alive") or ()) or r.get(
+                    "pending_takeovers"
+                ):
+                    done = False
+                    break
+            if done:
+                return
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"takeover of {victim} never completed on {w}"
+                )
+            time.sleep(0.05)
 
     def _settle(self, tagged_floor: Optional[int] = None,
                 skip_kafka_check: Optional[List[str]] = None) -> None:
@@ -329,7 +373,7 @@ class FabricDryrun:
             self.broker.stop()
 
     def _hello_payload(self) -> dict:
-        return {
+        payload = {
             "peers": {
                 w.wid: ["127.0.0.1", w.port]
                 for w in self.workers.values() if w.port is not None
@@ -338,6 +382,13 @@ class FabricDryrun:
             "send_timeout_ms": 2000.0,
             "grace_ms": 200.0,
         }
+        if self.churn:
+            payload.update({
+                "gossip_interval_ms": self.gossip_interval_ms,
+                "suspect_timeout_ms": self.suspect_timeout_ms,
+                "indirect_probes": 2,
+            })
+        return payload
 
     def _spawn_and_hello(self, wids: List[str]) -> None:
         for wid in wids:
@@ -381,9 +432,15 @@ class FabricDryrun:
         t_feed = time.perf_counter()
         for i, chunk in enumerate(chunks):
             if i == kill_at and self.victim in self.alive:
-                # SIGKILL mid-flood: no goodbye, no flush — the next
-                # send to it is the detection
-                self.workers[self.victim].kill()
+                if self.churn:
+                    # churn mode: SIGKILL with the feed PAUSED — no
+                    # forwarded line ever touches the victim again, so
+                    # detection is gossip's alone (the acceptance gate)
+                    self._churn_kill()
+                else:
+                    # SIGKILL mid-flood: no goodbye, no flush — the next
+                    # send to it is the detection
+                    self.workers[self.victim].kill()
             self._send_chunk(chunk)
             self.fed_lines += len(chunk)
         # a victim killed on the very last chunks may never be hit by
@@ -406,6 +463,38 @@ class FabricDryrun:
         )
         if self.rejoin and self.victim is not None:
             report["rejoin"] = self._rejoin_phase()
+        if self.churn:
+            report["join"] = self._join_phase()
+            report["suspect_refute"] = self._suspect_refute_phase()
+            report["leave"] = self._leave_phase()
+            if self.schedule is not None:
+                self.schedule.record("kill", dict(self.takeover))
+                self.schedule.record("join", {
+                    k: v for k, v in report["join"].items()
+                    if k != "invariants"
+                })
+                self.schedule.record(
+                    "slow_node",
+                    {k: v for k, v in report["suspect_refute"].items()
+                     if k != "invariants"},
+                )
+                self.schedule.record("leave", {
+                    k: v for k, v in report["leave"].items()
+                    if k != "invariants"
+                })
+                report["churn_schedule"] = self.schedule.rows()
+            report["invariants"].update({
+                f"join_{k}": v
+                for k, v in report["join"]["invariants"].items()
+            })
+            report["invariants"].update({
+                f"churn_{k}": v
+                for k, v in report["suspect_refute"]["invariants"].items()
+            })
+            report["invariants"].update({
+                f"leave_{k}": v
+                for k, v in report["leave"]["invariants"].items()
+            })
         return report
 
     # ---- rejoin / handback ----
@@ -468,6 +557,287 @@ class FabricDryrun:
                 "sync_idempotent_applied":
                     int(sync_ack.get("applied", 0))
                     == len(snap["decisions"]),
+            },
+        }
+
+    # ---- membership churn (gossip mode) ----
+
+    def _member_status(self, observer: str, target: str) -> Optional[str]:
+        snap = self._stats(observer)
+        members = (snap.get("membership") or {}).get("members") or {}
+        entry = members.get(target)
+        return entry.get("status") if entry else None
+
+    def _churn_kill(self) -> None:
+        """SIGKILL the victim with the feed paused: detection must come
+        from the gossip probe schedule alone (no forwarded line ever
+        fails against it).  Returns once every survivor has confirmed
+        the death AND completed its takeover."""
+        victim = self.victim
+        self.workers[victim].kill()
+        t_kill = time.monotonic()
+        self.alive.remove(victim)  # driver stops feeding it; NO broadcast
+        pre = {w: self._stats(w) for w in self.alive}
+        suspect_s = self.suspect_timeout_ms / 1000.0
+        interval_s = self.gossip_interval_ms / 1000.0
+        # worst case: full probe rotation to reach the victim, a failed
+        # direct + indirect round, then the suspicion window — plus CI
+        # slack (the measured distribution is what gets banked)
+        deadline = t_kill + suspect_s + interval_s * (
+            len(self.alive) + 6
+        ) + 30.0
+        confirmed: Dict[str, float] = {}
+        while len(confirmed) < len(self.alive):
+            if time.monotonic() > deadline:
+                missing = [w for w in self.alive if w not in confirmed]
+                raise RuntimeError(
+                    f"gossip never confirmed {victim} dead on {missing}"
+                )
+            for w in self.alive:
+                if w in confirmed:
+                    continue
+                if self._member_status(w, victim) in ("dead", "left"):
+                    confirmed[w] = round(time.monotonic() - t_kill, 3)
+            time.sleep(0.05)
+        self.detection = confirmed
+        self._await_takeovers(victim)
+        # the driver's own direct-feed journal for the victim
+        replayed = 0
+        for chunk in self._journal[victim]:
+            self._send_chunk(chunk, count_ack=False)
+            replayed += len(chunk)
+        self._journal[victim] = []
+        post = {w: self._stats(w) for w in self.alive}
+        survivor_replayed = sum(
+            int(post[w]["fabric"]["FabricReplayedLines"])
+            - int(pre[w]["fabric"]["FabricReplayedLines"])
+            for w in post
+        )
+        self.takeover = {
+            "victim": victim,
+            "mode": "gossip",
+            "detect_after_lines": self.fed_lines,
+            "detect_s": dict(confirmed),
+            "max_detect_s": max(confirmed.values()),
+            "suspect_timeout_s": suspect_s,
+            "gossip_interval_s": interval_s,
+            "driver_replayed_lines": replayed,
+            "survivor_replayed_lines": survivor_replayed,
+            "window_s": round(time.monotonic() - t_kill, 3),
+        }
+
+    def _join_phase(self) -> dict:
+        """Automatic join: a brand-new worker announces itself to ONE
+        live member (T_JOIN + snapshot pull, no driver HELLO, no
+        PEER_UP broadcast) and the fleet discovers it by gossip — then
+        a feed wave proves exactly-once handoff of its new ranges."""
+        from banjax_tpu.scenarios.shapes import LineChunk, generate
+
+        nid = f"w{self.n_workers}"
+        seed_worker = self.workers[self.alive[0]]
+        err_path = (
+            os.path.join(self.log_dir, f"{nid}.err")
+            if self.log_dir else None
+        )
+        newcomer = _spawn(
+            nid, self.broker.port, err_path,
+            extra_args=(
+                "--join", f"127.0.0.1:{seed_worker.port}",
+                "--gossip-interval-ms", str(self.gossip_interval_ms),
+                "--suspect-timeout-ms", str(self.suspect_timeout_ms),
+                "--grace-ms", "200.0",
+            ),
+        )
+        self.workers[nid] = newcomer
+        newcomer.read_ready(self.ready_timeout_s)
+        if newcomer.port is None:
+            raise RuntimeError(f"join worker failed: {newcomer.ready_error}")
+        # the fleet must converge on the newcomer WITHOUT any broadcast:
+        # the seed learned it from T_JOIN, everyone else from gossip
+        deadline = time.monotonic() + 60.0
+        while any(
+            self._member_status(w, nid) != "alive" for w in self.alive
+        ):
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"fleet never converged on joiner {nid}")
+            time.sleep(0.05)
+        self.alive.append(nid)
+        self._journal[nid] = []
+
+        base = {w: self._stats(w) for w in self.alive}
+        wave = generate(self.shape, self.seed + 1,
+                        max(0.25, self.scale * 0.25))
+        wave_chunks = [
+            list(ev.lines) for ev in wave.events
+            if isinstance(ev, LineChunk)
+        ]
+        wave_lines = sum(len(c) for c in wave_chunks)
+        for chunk in wave_chunks:
+            self._send_chunk(chunk)
+            self.fed_lines += len(chunk)
+        # the joiner's kafka reader attached at the topic tail
+        self._settle(tagged_floor=self._tagged_commands(),
+                     skip_kafka_check=[nid])
+        final = {w: self._stats(w) for w in self.alive}
+
+        def _local(w: str) -> int:
+            cur = int(final[w]["fabric"]["FabricLocalLines"])
+            prev = int(base[w]["fabric"]["FabricLocalLines"]) \
+                if w in base else 0
+            return cur - prev
+
+        locals_sum = sum(_local(w) for w in self.alive)
+        synced = int(newcomer.ready_info.get("synced", 0))
+        return {
+            "joiner": nid,
+            "seed_member": seed_worker.wid,
+            "synced_decisions": synced,
+            "wave_lines": wave_lines,
+            "wave_locals_sum": locals_sum,
+            "joiner_local_lines": _local(nid),
+            "invariants": {
+                "wave_exactly_once": locals_sum == wave_lines,
+                "joiner_took_lines": _local(nid) > 0,
+                "snapshot_synced": synced > 0,
+                "no_survivor_restart": True,  # by construction: no
+                # respawn, no HELLO re-push — convergence was gossip
+            },
+        }
+
+    def _suspect_refute_phase(self) -> dict:
+        """Slow-node cycle: arm a sleep failpoint on one member's gossip
+        ack path so every probe against it times out — the fleet must
+        SUSPECT it, and once disarmed the member must refute its own
+        suspicion (incarnation bump) and return to ALIVE everywhere.
+        Confirmed-dead during the window is tolerated (a slow node CAN
+        time out — refute-after-dead heals it; recall is unaffected)."""
+        target = self.alive[-1]
+        observers = [w for w in self.alive if w != target]
+        pre = {w: self._stats(w) for w in self.alive}
+        delay_x = self.schedule.slow_delay_x if self.schedule else 3.0
+        self.workers[target].request(wire.T_FAILPOINT, {
+            "name": "fabric.gossip.ack", "mode": "sleep",
+            "delay_s": (self.gossip_interval_ms / 1000.0) * delay_x,
+        })
+        suspected = False
+        deadline = time.monotonic() + 60.0
+        while not suspected and time.monotonic() < deadline:
+            for w in observers:
+                snap = self._stats(w)
+                d = int(snap["fabric"]["FabricMembershipSuspects"]) - int(
+                    pre[w]["fabric"]["FabricMembershipSuspects"]
+                )
+                if d >= 1:
+                    suspected = True
+                    break
+            time.sleep(0.05)
+        self.workers[target].request(wire.T_FAILPOINT, {
+            "name": "fabric.gossip.ack", "disarm": True,
+        })
+        deadline = time.monotonic() + 60.0
+        while any(
+            self._member_status(w, target) != "alive" for w in observers
+        ):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"{target} never refuted back to alive"
+                )
+            time.sleep(0.05)
+        final = {w: self._stats(w) for w in self.alive}
+
+        def _delta(key: str) -> int:
+            return sum(
+                int(final[w]["fabric"][key]) - int(pre[w]["fabric"][key])
+                for w in self.alive
+            )
+
+        refuted = _delta("FabricMembershipRefuted")
+        return {
+            "target": target,
+            "suspects_delta": _delta("FabricMembershipSuspects"),
+            "refuted_delta": refuted,
+            "confirmed_dead_delta": _delta("FabricMembershipConfirmedDead"),
+            "invariants": {
+                "suspicion_observed": suspected,
+                "refutation_observed": refuted >= 1,
+                "target_alive_everywhere": True,  # the wait above gates it
+            },
+        }
+
+    def _leave_phase(self) -> dict:
+        """Planned leave: the newest member drains and departs.  Zero
+        shed, zero replay, LEFT visible everywhere, and a follow-up wave
+        lands exactly-once on the remaining fleet."""
+        from banjax_tpu.scenarios.shapes import LineChunk, generate
+
+        leaver = self.alive[-1]
+        rest = [w for w in self.alive if w != leaver]
+        pre = {w: self._stats(w) for w in self.alive}
+        ack = self.workers[leaver].request(wire.T_LEAVE, {})
+        self.alive.remove(leaver)
+        try:
+            self.workers[leaver].proc.wait(timeout=30)
+            departed = True
+        except subprocess.TimeoutExpired:
+            departed = False
+        # the LEFT digest was announced synchronously before the ack:
+        # nobody may still believe the leaver owns anything
+        observed_left = all(
+            self._member_status(w, leaver) == "left" for w in rest
+        )
+
+        base = {w: self._stats(w) for w in rest}
+        wave = generate(self.shape, self.seed + 2,
+                        max(0.25, self.scale * 0.25))
+        wave_chunks = [
+            list(ev.lines) for ev in wave.events
+            if isinstance(ev, LineChunk)
+        ]
+        wave_lines = sum(len(c) for c in wave_chunks)
+        for chunk in wave_chunks:
+            self._send_chunk(chunk)
+            self.fed_lines += len(chunk)
+        self._settle(tagged_floor=self._tagged_commands())
+        final = {w: self._stats(w) for w in rest}
+
+        def _shed(snap: dict) -> int:
+            return int(snap["sched"]["PipelineShedLines"]) + int(
+                snap["fabric"]["FabricShedLines"]
+            )
+
+        # the leaver's own final ledger rides the T_LEAVE ack (the
+        # process is gone by now)
+        leaver_shed = _shed(ack) - _shed(pre[leaver])
+        rest_shed = sum(_shed(final[w]) - _shed(pre[w]) for w in rest)
+        replay_delta = sum(
+            int(final[w]["fabric"]["FabricReplayedLines"])
+            - int(pre[w]["fabric"]["FabricReplayedLines"])
+            for w in rest
+        ) + (
+            int(ack["fabric"]["FabricReplayedLines"])
+            - int(pre[leaver]["fabric"]["FabricReplayedLines"])
+        )
+        locals_sum = sum(
+            int(final[w]["fabric"]["FabricLocalLines"])
+            - int(base[w]["fabric"]["FabricLocalLines"])
+            for w in rest
+        )
+        return {
+            "leaver": leaver,
+            "drain_ms": ack.get("drain_ms"),
+            "announced": ack.get("announced"),
+            "wave_lines": wave_lines,
+            "wave_locals_sum": locals_sum,
+            "shed_leaver": leaver_shed,
+            "shed_rest": rest_shed,
+            "replayed_lines": replay_delta,
+            "invariants": {
+                "drain_flushed": bool(ack.get("flushed")),
+                "departed": departed,
+                "left_observed_everywhere": observed_left,
+                "zero_shed": leaver_shed == 0 and rest_shed == 0,
+                "zero_replay": replay_delta == 0,
+                "wave_exactly_once": locals_sum == wave_lines,
             },
         }
 

@@ -3,13 +3,20 @@
 The router is the zero-lost-ban mechanism.  Every chunk successfully
 forwarded to a peer is also appended to that peer's journal (bounded
 deque of recent chunks).  When a peer is declared dead — a send
-exhausted its retry budget, its breaker opened, or a membership frame
-said so — the router:
+exhausted its retry budget, its breaker opened, a membership frame
+said so, or gossip confirmed a suspicion (fabric/membership.py) — the
+router:
 
   1. passes the `fabric.takeover` failpoint (armable chaos),
   2. removes the peer from the alive set (the consistent-hash ring
      then hands its ranges to the next alive points automatically),
-  3. waits `fabric_takeover_grace_ms` for in-flight work to drain,
+  3. schedules the journal replay for `fabric_takeover_grace_ms`
+     later — the grace is a DEADLINE, not a sleep: `mark_dead`
+     returns immediately, so a death event mid-flood never stalls the
+     routing caller.  The replay fires from whichever comes first of
+     a `route()` call observing the deadline passed, a `poll()` tick
+     (the gossip loop calls it every interval), or the dedicated
+     grace timer thread,
   4. replays the dead peer's entire journal through normal routing, so
      the successor re-derives every window state the dead shard held.
 
@@ -19,6 +26,14 @@ double-process lines a survivor already saw — that can only ADD bans
 (a precision cost the harness reports), never lose one: recall vs the
 oracle stays 1.0.  Lines with no alive owner are counted shed, never
 silently dropped.
+
+Dynamic membership adds two transitions the static fabric never
+needed: `add_node` (a gossip-discovered joiner — the ring is rebuilt
+to include it, which steals keys only from the joiner's ring
+successors) and `mark_left` (a graceful leaver — removed from the
+alive set with its journal CLEARED, no replay: the leaver drained its
+pipeline and replicated its decisions before departing, so a replay
+could only double-process).
 """
 
 from __future__ import annotations
@@ -63,10 +78,18 @@ class FabricRouter:
         self.stats = stats or FabricStats()
         self.health = health
         self.takeover_grace_s = float(takeover_grace_ms) / 1000.0
+        self._journal_chunks = int(journal_chunks)
         self._clock = clock
         self._sleep = sleep
         self._lock = threading.RLock()
         self.alive = set(ring.node_ids)
+        # graceful-membership hook: a merge callable installed by
+        # SwimMembership so digests piggybacked on T_LINES acks feed
+        # the membership table (convergence rides the data path)
+        self.gossip_merge: Optional[Callable[[list], None]] = None
+        # peer -> (declared_dead_at, replay_deadline): takeovers whose
+        # grace window is still open (deadline-polled, never slept-on)
+        self._pending_takeover: Dict[str, tuple] = {}
         self._journal: Dict[str, deque] = {
             p: deque(maxlen=journal_chunks) for p in ring.node_ids
         }
@@ -81,6 +104,7 @@ class FabricRouter:
         """Deliver every line to its owner.  Returns the disposition
         ledger {local, forwarded, shed} — their sum is always
         len(lines)."""
+        self.poll()  # complete any takeover whose grace deadline passed
         out = {"local": 0, "forwarded": 0, "shed": 0}
         with self._lock:
             self._route_locked(list(lines), out, replay)
@@ -106,7 +130,7 @@ class FabricRouter:
                 out["local"] += len(group)
                 continue
             try:
-                self.peers[owner].request(
+                _rt, rpayload = self.peers[owner].request(
                     wire.T_LINES, {"lines": group, "replay": replay}
                 )
             except PeerUnavailable:
@@ -120,16 +144,21 @@ class FabricRouter:
                 comp = self.health.get(f"fabric.peer.{owner}")
                 if comp is not None:
                     comp.beat()
+            if self.gossip_merge is not None:
+                piggy = rpayload.get("gossip")
+                if piggy:
+                    self.gossip_merge(piggy)
 
     # ---- membership / takeover ----
 
     def mark_dead(self, peer_id: str, reason: str = "") -> None:
-        """Declare a peer dead and take over its range: grace, then
-        journal replay through normal routing."""
+        """Declare a peer dead and schedule the takeover of its range.
+        Returns immediately: the grace window is a deadline (completed
+        by route()/poll()/the grace timer), never an inline sleep — a
+        death event mid-flood must not stall the routing caller."""
         with self._lock:
             if peer_id not in self.alive or peer_id == self.node_id:
                 return
-            t0 = self._clock()
             try:
                 failpoints.check("fabric.takeover")
             except failpoints.FaultInjected:
@@ -143,8 +172,47 @@ class FabricRouter:
                 comp = self.health.get(f"fabric.peer.{peer_id}")
                 if comp is not None:
                     comp.failed(reason or "declared dead")
-            if self.takeover_grace_s > 0:
-                self._sleep(self.takeover_grace_s)
+            t0 = self._clock()
+            self._pending_takeover[peer_id] = (
+                t0, t0 + self.takeover_grace_s
+            )
+        if self.takeover_grace_s <= 0:
+            self._complete_takeover(peer_id)
+            return
+        threading.Thread(
+            target=self._grace_then_complete, args=(peer_id,),
+            name=f"fabric-takeover-{peer_id}", daemon=True,
+        ).start()
+
+    def _grace_then_complete(self, peer_id: str) -> None:
+        self._sleep(self.takeover_grace_s)
+        self._complete_takeover(peer_id)
+
+    def poll(self) -> None:
+        """Complete every pending takeover whose grace deadline has
+        passed.  Cheap when nothing is pending; called at route()
+        entry and from the gossip tick."""
+        if not self._pending_takeover:
+            return
+        now = self._clock()
+        with self._lock:
+            due = [
+                p for p, (_t0, deadline)
+                in self._pending_takeover.items() if now >= deadline
+            ]
+        for peer_id in due:
+            self._complete_takeover(peer_id)
+
+    def _complete_takeover(self, peer_id: str) -> None:
+        """Drain the dead peer's journal through normal routing —
+        idempotent: the pending entry is popped under the lock, so the
+        grace timer, route() and poll() can race without replaying
+        twice."""
+        with self._lock:
+            ent = self._pending_takeover.pop(peer_id, None)
+            if ent is None:
+                return
+            t0, _deadline = ent
             chunks = list(self._journal[peer_id])
             self._journal[peer_id].clear()
             replayed = 0
@@ -155,6 +223,12 @@ class FabricRouter:
                 self._route_locked(list(chunk), out, replay=True)
             self.stats.note_takeover(peer_id, self._clock() - t0, replayed)
 
+    def takeover_pending(self, peer_id: Optional[str] = None) -> bool:
+        with self._lock:
+            if peer_id is None:
+                return bool(self._pending_takeover)
+            return peer_id in self._pending_takeover
+
     def mark_alive(
         self, peer_id: str,
         host: Optional[str] = None, port: Optional[int] = None,
@@ -164,7 +238,11 @@ class FabricRouter:
         a rejoin never double-processes."""
         with self._lock:
             if peer_id == self.node_id:
+                self.alive.add(peer_id)  # undo a self-drain (aborted leave)
                 return
+            # a revival during the grace window voids the takeover: the
+            # peer is back, its journal is its own again
+            self._pending_takeover.pop(peer_id, None)
             client = self.peers.get(peer_id)
             if client is not None and host is not None and port is not None:
                 client.connect_to(host, port)
@@ -173,11 +251,59 @@ class FabricRouter:
             if self.health is not None and peer_id in self.ring.node_ids:
                 self.health.register(f"fabric.peer.{peer_id}").ok("rejoined")
 
+    def add_node(
+        self, peer_id: str, client: Optional[PeerClient],
+    ) -> None:
+        """A brand-new member (gossip join): rebuild the ring to
+        include it.  Ring insertion steals keys only from the joiner's
+        ring successors (tests/unit/test_fabric.py proves the bound);
+        nobody else's ownership moves."""
+        with self._lock:
+            if peer_id in self.ring.node_ids:
+                self.mark_alive(
+                    peer_id,
+                    host=getattr(client, "host", None),
+                    port=getattr(client, "port", None),
+                )
+                return
+            self.ring = ConsistentHashRing(
+                self.ring.node_ids + (peer_id,), vnodes=self.ring.vnodes
+            )
+            if peer_id != self.node_id:
+                self.peers[peer_id] = client
+            self._journal[peer_id] = deque(maxlen=self._journal_chunks)
+            self.alive.add(peer_id)
+            self.stats.note_peer(peer_id, True)
+            if self.health is not None and peer_id != self.node_id:
+                self.health.register(f"fabric.peer.{peer_id}").ok("joined")
+
+    def mark_left(self, peer_id: str, reason: str = "graceful leave") -> None:
+        """A peer departed gracefully: it drained its pipeline and
+        replicated its decisions before announcing LEFT, so its journal
+        is CLEARED without replay — a replay could only double-process.
+        Calling it on our own id is the leaver's self-drain: drop out
+        of the alive set so every subsequent line forwards to its new
+        owner (the pure-membership handback)."""
+        with self._lock:
+            self.alive.discard(peer_id)
+            self._pending_takeover.pop(peer_id, None)
+            journal = self._journal.get(peer_id)
+            if journal is not None:
+                journal.clear()
+            if peer_id == self.node_id:
+                return
+            self.stats.note_peer(peer_id, False)
+            if self.health is not None:
+                comp = self.health.get(f"fabric.peer.{peer_id}")
+                if comp is not None:
+                    comp.ok(reason)  # a planned leave is not a failure
+
     # ---- introspection (fabric.json / /metrics) ----
 
     def describe(self) -> Dict[str, object]:
         with self._lock:
             alive = sorted(self.alive)
+            pending = sorted(self._pending_takeover)
             peers = {
                 pid: {
                     "alive": pid in self.alive,
@@ -197,6 +323,7 @@ class FabricRouter:
             "node_id": self.node_id,
             "vnodes": self.ring.vnodes,
             "alive": alive,
+            "pending_takeovers": pending,
             "peers": peers,
             "ownership": self.ring.ownership_fractions(set(alive)),
             "last_takeover": self.stats.last_takeover,

@@ -1,8 +1,7 @@
 """Production assembly of the decision fabric for cli.BanjaxApp.
 
 One FabricService per process, built only when `fabric_enabled`.  It
-owns the four fabric pieces and exposes exactly the seams the app
-needs:
+owns the fabric pieces and exposes exactly the seams the app needs:
 
   * ``submit(lines)`` — the tailer's consume path: lines this shard
     owns go down the local pipeline, everything else rides a peer
@@ -15,10 +14,16 @@ needs:
   * ``describe()`` — the flight recorder's fabric.json and the
     /metrics peer table.
 
-The wire server handles peer frames only (LINES / PING / PEER_DOWN /
-PEER_UP / STATS); topology is static from `fabric_peers` — dynamic
-membership changes arrive as PEER_DOWN/PEER_UP frames or are detected
-locally by a failed send.
+Topology: `fabric_peers` seeds the ring.  With gossip membership on
+(`fabric_gossip_interval_ms > 0`, the default) the SWIM layer
+(membership.py) owns liveness from there — periodic probes confirm
+deaths within the suspect timeout without waiting for a forwarded line
+to fail, newcomers announce with T_JOIN and are ring-inserted live,
+and graceful leavers gossip LEFT.  PEER_DOWN/PEER_UP admin frames
+funnel through the same membership table so a rejoining worker is
+announced exactly once.  With gossip off the fabric degrades to
+PR 11's static behavior (death discovered by a failed send or an admin
+frame only).
 """
 
 from __future__ import annotations
@@ -27,6 +32,7 @@ from typing import Any, Callable, Dict, Optional, Sequence
 
 from banjax_tpu.fabric import wire
 from banjax_tpu.fabric.hashring import ConsistentHashRing
+from banjax_tpu.fabric.membership import SwimMembership
 from banjax_tpu.fabric.node import FabricNode
 from banjax_tpu.fabric.peer import PeerClient
 from banjax_tpu.fabric.replication import (
@@ -58,6 +64,7 @@ class FabricService:
             transport = WireKafkaTransport()
         self.node_id = config.fabric_node_id
         self.stats = FabricStats()
+        self._send_timeout_ms = config.fabric_send_timeout_ms
         peers_cfg = dict(config.fabric_peers or {})
         node_ids = sorted(peers_cfg) if peers_cfg else [self.node_id]
         ring = ConsistentHashRing(node_ids, vnodes=config.fabric_vnodes)
@@ -84,22 +91,51 @@ class FabricService:
             takeover_grace_ms=config.fabric_takeover_grace_ms,
         )
         lhost, lport = _split_addr(config.fabric_listen)
-        self.node = FabricNode(lhost, lport, handlers={
+        self.membership: Optional[SwimMembership] = None
+        handlers = {
             wire.T_LINES: self._h_lines,
             wire.T_PING: self._h_ping,
             wire.T_PEER_DOWN: self._h_peer_down,
             wire.T_PEER_UP: self._h_peer_up,
             wire.T_STATS: self._h_stats,
-        })
+        }
+        if getattr(config, "fabric_gossip_interval_ms", 0) > 0:
+            self.membership = SwimMembership(
+                self.node_id, lhost, lport,
+                router=self.router, stats=self.stats,
+                gossip_interval_ms=config.fabric_gossip_interval_ms,
+                suspect_timeout_ms=config.fabric_suspect_timeout_ms,
+                indirect_probes=config.fabric_indirect_probes,
+                peer_factory=self._make_client,
+            )
+            self.membership.seed({
+                pid: _split_addr(addr) for pid, addr in peers_cfg.items()
+            })
+            # convergence rides the data path: digests piggybacked on
+            # forwarded-chunk acks feed the membership table
+            self.router.gossip_merge = self.membership.merge
+            handlers[wire.T_GOSSIP_PING] = self.membership.handle_ping
+            handlers[wire.T_GOSSIP_PING_REQ] = self.membership.handle_ping_req
+            handlers[wire.T_JOIN] = self.membership.handle_join
+        self.node = FabricNode(lhost, lport, handlers=handlers)
         self._local_submit = local_submit
+
+    def _make_client(self, pid: str, host: str, port: int) -> PeerClient:
+        return PeerClient(
+            pid, host, port, send_timeout_ms=self._send_timeout_ms
+        )
 
     # ---- lifecycle ----
 
     def start(self) -> "FabricService":
         self.node.start()
+        if self.membership is not None:
+            self.membership.start()
         return self
 
     def stop(self) -> None:
+        if self.membership is not None:
+            self.membership.stop()
         self.node.stop()
         for client in self.router.peers.values():
             if client is not None:
@@ -122,6 +158,8 @@ class FabricService:
         out: Dict[str, object] = {"enabled": True}
         out.update(self.router.describe())
         out["stats"] = self.stats.peek()
+        if self.membership is not None:
+            out["membership"] = self.membership.describe()
         return out
 
     # ---- wire handlers (peer side) ----
@@ -129,32 +167,45 @@ class FabricService:
     def _h_lines(self, payload: dict):
         lines = payload.get("lines", [])
         self.stats.note_received(len(lines))
+        piggy = (
+            {"gossip": self.membership.digest()}
+            if self.membership is not None else {}
+        )
         if payload.get("route"):
             out = self.router.route(lines)
-            return wire.T_ACK, {"n": len(lines), **out}
+            return wire.T_ACK, {"n": len(lines), **out, **piggy}
         self._local_submit(lines)
         self.stats.note_local(len(lines))
-        return wire.T_ACK, {"n": len(lines), "local": len(lines)}
+        return wire.T_ACK, {"n": len(lines), "local": len(lines), **piggy}
 
     def _h_ping(self, payload: dict):
         return wire.T_PONG, {"node_id": self.node_id}
 
     def _h_peer_down(self, payload: dict):
-        self.router.mark_dead(
-            str(payload.get("peer", "")), reason="peer_down frame"
-        )
+        pid = str(payload.get("peer", ""))
+        if self.membership is not None:
+            self.membership.note_peer_down(pid)
+        else:
+            self.router.mark_dead(pid, reason="peer_down frame")
         return wire.T_ACK, {}
 
     def _h_peer_up(self, payload: dict):
-        self.router.mark_alive(
-            str(payload.get("peer", "")),
-            host=payload.get("host"), port=payload.get("port"),
-        )
+        pid = str(payload.get("peer", ""))
+        host, port = payload.get("host"), payload.get("port")
+        if self.membership is not None:
+            # exactly-once funnel: a duplicate notification (harness
+            # handshake racing gossip discovery) is a no-op
+            self.membership.note_peer_up(pid, host=host, port=port)
+        else:
+            self.router.mark_alive(pid, host=host, port=port)
         return wire.T_ACK, {}
 
     def _h_stats(self, payload: dict):
-        return wire.T_STATS_R, {
+        out = {
             "node_id": self.node_id,
             "fabric": self.stats.peek(),
             "router": self.router.describe(),
         }
+        if self.membership is not None:
+            out["membership"] = self.membership.describe()
+        return wire.T_STATS_R, out

@@ -38,6 +38,15 @@ class FabricStats:
         self.takeover_duration = Histogram()
         self.peer_up: Dict[str, bool] = {}
         self.last_takeover: Optional[Dict[str, object]] = None
+        # ---- gossip membership (fabric/membership.py) ----
+        self.membership_suspects = 0       # alive -> suspect transitions
+        self.membership_confirmed_dead = 0  # suspicion timeouts expired
+        self.membership_refuted = 0        # suspect -> alive (incarnation bump)
+        self.membership_joined = 0         # new/revived members inserted
+        self.membership_left = 0           # graceful departures observed
+        self.gossip_bytes = 0              # probe frames + piggyback digests
+        self.member_state: Dict[str, str] = {}  # peer -> alive/suspect/dead/left
+        self.detection_time = Histogram()  # last liveness evidence -> confirmed dead
 
     def note_forwarded(self, n: int) -> None:
         with self._lock:
@@ -79,6 +88,33 @@ class FabricStats:
         with self._lock:
             self.peer_up[peer_id] = up
 
+    def note_membership_event(self, event: str) -> None:
+        """Count one membership transition (membership.py event names)."""
+        with self._lock:
+            if event == "suspect":
+                self.membership_suspects += 1
+            elif event == "confirmed_dead":
+                self.membership_confirmed_dead += 1
+            elif event == "refuted":
+                self.membership_refuted += 1
+            elif event == "joined":
+                self.membership_joined += 1
+            elif event == "left":
+                self.membership_left += 1
+
+    def note_member_state(self, peer_id: str, state: str) -> None:
+        with self._lock:
+            self.member_state[peer_id] = state
+
+    def note_gossip_bytes(self, n: int) -> None:
+        with self._lock:
+            self.gossip_bytes += n
+
+    def note_detection(self, duration_s: float) -> None:
+        """Failure-detection latency: last liveness evidence for the
+        member -> its death confirmed in this node's view."""
+        self.detection_time.observe(duration_s)
+
     def note_takeover(
         self, peer_id: str, duration_s: float, replayed_lines: int
     ) -> None:
@@ -105,13 +141,29 @@ class FabricStats:
                 "FabricDuplicatesSuppressed": self.duplicate_suppressed,
                 "FabricReplicatedApplied": self.replicated_applied,
                 "FabricTakeovers": self.takeovers,
+                "FabricMembershipSuspects": self.membership_suspects,
+                "FabricMembershipConfirmedDead":
+                    self.membership_confirmed_dead,
+                "FabricMembershipRefuted": self.membership_refuted,
+                "FabricMembershipJoined": self.membership_joined,
+                "FabricMembershipLeft": self.membership_left,
+                "FabricGossipBytes": self.gossip_bytes,
             }
 
     def peers_snapshot(self) -> Dict[str, bool]:
         with self._lock:
             return dict(self.peer_up)
 
+    def member_states_snapshot(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self.member_state)
+
     def takeover_snapshot(
         self,
     ) -> Tuple[Tuple[float, ...], list, float, int]:
         return self.takeover_duration.snapshot()
+
+    def detection_snapshot(
+        self,
+    ) -> Tuple[Tuple[float, ...], list, float, int]:
+        return self.detection_time.snapshot()

@@ -38,6 +38,13 @@ T_PEER_UP = 13     # -> T_ACK         membership change: peer rejoined
 T_FLUSH = 14       # -> T_ACK         drain the pipeline to quiescence
 T_SHUTDOWN = 15    # -> T_ACK         clean exit
 T_ERR = 16         # any request may answer this; payload has "error"
+T_GOSSIP_PING = 17     # -> T_GOSSIP_ACK   SWIM direct probe, digest rides
+T_GOSSIP_ACK = 18
+T_GOSSIP_PING_REQ = 19  # -> T_GOSSIP_ACK  SWIM indirect probe via a relay
+T_JOIN = 20        # -> T_JOIN_R      announce + membership/snapshot pull
+T_JOIN_R = 21
+T_LEAVE = 22       # -> T_ACK         admin: graceful drain, then depart
+T_FAILPOINT = 23   # -> T_ACK         harness: arm/disarm a failpoint
 
 
 class FrameError(OSError):

@@ -12,6 +12,25 @@ Startup protocol (stdout, one JSON line):  the worker prints
 warmed (device compile done) and the kafka reader has proven attached
 (its own `fabric_ping` round-tripped), so a SIGKILL any time after
 READY lands on a fully live shard.
+
+Two ways into the ring:
+
+  * **HELLO** (driver-pushed topology): the harness sends T_HELLO with
+    the full peer map; gossip membership starts from it as a seed when
+    the payload carries `gossip_interval_ms > 0`.
+  * **--join host:port** (automatic join, no driver involvement): the
+    worker announces itself to one live seed with T_JOIN, builds its
+    router from the returned membership digest, pulls the seed's
+    decision snapshot (T_SNAPSHOT -> local T_SYNC application), starts
+    gossiping, and only then prints READY — the surviving fleet learns
+    of it purely through gossip, no restarts, no broadcast.
+
+Planned leave (T_LEAVE): stop owning (router.mark_left on self — every
+subsequent line forwards to its new owner), flush the pipeline to
+quiescence, announce LEFT via a final gossip digest to every alive
+member, then depart.  Crash takeover replays the victim's journal;
+graceful leave hands ranges back with the journal untouched-by-replay
+because nothing was lost.
 """
 
 from __future__ import annotations
@@ -19,6 +38,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import socket as _socket
 import sys
 import threading
 import time
@@ -41,10 +61,21 @@ def main(argv=None) -> int:
     ap.add_argument("--send-timeout-ms", type=float, default=800.0)
     ap.add_argument("--grace-ms", type=float, default=200.0)
     ap.add_argument("--vnodes", type=int, default=64)
+    ap.add_argument("--gossip-interval-ms", type=float, default=0.0,
+                    help="SWIM probe cadence; 0 = gossip off (HELLO "
+                         "payload may still enable it)")
+    ap.add_argument("--suspect-timeout-ms", type=float, default=1200.0)
+    ap.add_argument("--indirect-probes", type=int, default=2)
+    ap.add_argument("--graceful-leave-ms", type=float, default=5000.0)
+    ap.add_argument("--join", default="",
+                    help="host:port of one live member — join its ring "
+                         "via gossip announce + snapshot sync instead of "
+                         "waiting for a driver HELLO")
     args = ap.parse_args(argv)
 
     # heavy imports AFTER the backend pin
     from banjax_tpu.decisions.model import Decision
+    from banjax_tpu.fabric import membership as swim
     from banjax_tpu.fabric import wire
     from banjax_tpu.fabric.node import FabricNode
     from banjax_tpu.fabric.peer import PeerClient
@@ -57,6 +88,7 @@ def main(argv=None) -> int:
     from banjax_tpu.fabric.hashring import ConsistentHashRing
     from banjax_tpu.fabric.stats import FabricStats
     from banjax_tpu.ingest.kafka_io import handle_command
+    from banjax_tpu.resilience import failpoints
     from banjax_tpu.resilience.health import HealthRegistry
     from banjax_tpu.scenarios.runtime import (
         RecordingBanner,
@@ -95,6 +127,12 @@ def main(argv=None) -> int:
             "fabric_vnodes": args.vnodes,
             "fabric_send_timeout_ms": args.send_timeout_ms,
             "fabric_takeover_grace_ms": args.grace_ms,
+            "fabric_gossip_interval_ms": args.gossip_interval_ms,
+            "fabric_suspect_timeout_ms": max(
+                args.suspect_timeout_ms, args.gossip_interval_ms * 2 + 1
+            ),
+            "fabric_indirect_probes": args.indirect_probes,
+            "fabric_graceful_leave_ms": args.graceful_leave_ms,
         },
     )
     cfg, sched, dynamic_lists = parts.cfg, parts.sched, parts.dynamic_lists
@@ -180,11 +218,34 @@ def main(argv=None) -> int:
 
     # ---- fabric server ----
     shutdown = threading.Event()
-    state = {"router": None}
+    state = {"router": None, "membership": None}
 
     def _local_submit(lines) -> int:
         sched.submit(list(lines))
         return len(lines)
+
+    def _make_client(pid, host, port, timeout_ms=None):
+        return PeerClient(
+            pid, host, int(port),
+            send_timeout_ms=float(timeout_ms or args.send_timeout_ms),
+        )
+
+    def _start_membership(router, seeds, gossip_ms, suspect_ms,
+                          indirect, listen_port):
+        ms = swim.SwimMembership(
+            node_id, "127.0.0.1", listen_port,
+            router=router, stats=fstats,
+            gossip_interval_ms=gossip_ms,
+            suspect_timeout_ms=suspect_ms,
+            indirect_probes=indirect,
+            peer_factory=_make_client,
+        )
+        if seeds:
+            ms.seed(seeds)
+        router.gossip_merge = ms.merge
+        state["membership"] = ms
+        ms.start()
+        return ms
 
     def h_hello(payload):
         peers_map = payload.get("peers", {})
@@ -196,52 +257,155 @@ def main(argv=None) -> int:
             if pid == node_id:
                 clients[pid] = None
                 continue
-            clients[pid] = PeerClient(
-                pid, addr[0], int(addr[1]),
-                send_timeout_ms=float(
-                    payload.get("send_timeout_ms", args.send_timeout_ms)
-                ),
+            clients[pid] = _make_client(
+                pid, addr[0], addr[1],
+                payload.get("send_timeout_ms", args.send_timeout_ms),
             )
-        state["router"] = FabricRouter(
+        router = FabricRouter(
             node_id, ring, clients, _local_submit, stats=fstats,
             health=health,
             takeover_grace_ms=float(
                 payload.get("grace_ms", args.grace_ms)
             ),
         )
+        state["router"] = router
+        gossip_ms = float(
+            payload.get("gossip_interval_ms", args.gossip_interval_ms)
+        )
+        if gossip_ms > 0:
+            _start_membership(
+                router,
+                {pid: (addr[0], int(addr[1]))
+                 for pid, addr in peers_map.items()},
+                gossip_ms,
+                float(payload.get(
+                    "suspect_timeout_ms", args.suspect_timeout_ms
+                )),
+                int(payload.get("indirect_probes", args.indirect_probes)),
+                node.port,
+            )
         return wire.T_HELLO_R, {"node_id": node_id}
 
     def h_lines(payload):
         lines = payload.get("lines", [])
         fstats.note_received(len(lines))
         router = state["router"]
+        ms = state["membership"]
+        piggy = {"gossip": ms.digest()} if ms is not None else {}
         if payload.get("route") and router is not None:
             out = router.route(lines)
-            return wire.T_ACK, {"n": len(lines), **out}
+            return wire.T_ACK, {"n": len(lines), **out, **piggy}
         _local_submit(lines)
         fstats.note_local(len(lines))
-        return wire.T_ACK, {"n": len(lines), "local": len(lines)}
+        return wire.T_ACK, {
+            "n": len(lines), "local": len(lines), **piggy
+        }
 
     def h_peer_down(payload):
+        pid = str(payload.get("peer", ""))
+        ms = state["membership"]
         router = state["router"]
-        if router is not None:
-            router.mark_dead(
-                str(payload.get("peer", "")), reason="driver broadcast"
-            )
+        if ms is not None:
+            ms.note_peer_down(pid)
+        elif router is not None:
+            router.mark_dead(pid, reason="driver broadcast")
         return wire.T_ACK, {}
 
     def h_peer_up(payload):
+        pid = str(payload.get("peer", ""))
+        ms = state["membership"]
         router = state["router"]
-        if router is not None:
+        if ms is not None:
+            # exactly-once funnel: a duplicate notification (driver
+            # handshake racing gossip discovery) is a no-op here
+            ms.note_peer_up(
+                pid, host=payload.get("host"), port=payload.get("port")
+            )
+        elif router is not None:
             router.mark_alive(
-                str(payload.get("peer", "")),
-                host=payload.get("host"),
-                port=payload.get("port"),
+                pid, host=payload.get("host"), port=payload.get("port")
             )
         return wire.T_ACK, {}
 
+    def h_gossip_ping(payload):
+        ms = state["membership"]
+        if ms is None:
+            return wire.T_ERR, {"error": "gossip disabled"}
+        return ms.handle_ping(payload)
+
+    def h_gossip_ping_req(payload):
+        ms = state["membership"]
+        if ms is None:
+            return wire.T_ERR, {"error": "gossip disabled"}
+        return ms.handle_ping_req(payload)
+
+    def h_join(payload):
+        ms = state["membership"]
+        if ms is None:
+            return wire.T_ERR, {"error": "gossip disabled"}
+        return ms.handle_join(payload)
+
+    def h_leave(payload):
+        """Planned leave: drain, hand back, announce, depart."""
+        t0 = time.monotonic()
+        ms = state["membership"]
+        router = state["router"]
+        if router is not None:
+            # stop owning FIRST: every line arriving after this forwards
+            # to its new owner, so nothing new lands in our pipeline
+            router.mark_left(node_id)
+        budget_s = float(
+            payload.get("timeout", args.graceful_leave_ms / 1000.0)
+        )
+        flushed = sched.flush(max(budget_s, 1.0))
+        announced = 0
+        if ms is not None:
+            digest = ms.begin_leave()
+            for row in digest:
+                rid, status, _inc, host, port = row
+                if rid == node_id or status != swim.ALIVE:
+                    continue
+                if ms._send(
+                    host, int(port), wire.T_GOSSIP_PING,
+                    {"from": node_id, "digest": digest},
+                ) is not None:
+                    announced += 1
+            ms.stop()
+        # depart shortly after the ack flushes to the admin socket
+        threading.Timer(0.3, shutdown.set).start()
+        return wire.T_ACK, {
+            "flushed": bool(flushed),
+            "announced": announced,
+            "drain_ms": (time.monotonic() - t0) * 1000.0,
+            # final ledger: the driver audits the leaver's zero-shed /
+            # zero-replay claim after the process is gone
+            "sched": sched.stats.peek(),
+            "fabric": fstats.peek(),
+            "bans": list(inner_banner.regex_ban_logs),
+        }
+
+    def h_failpoint(payload):
+        """Harness chaos surface: arm/disarm a named failpoint in THIS
+        process (the slow-node suspect/refute cycle arms
+        fabric.gossip.ack with mode=sleep here)."""
+        name = str(payload.get("name", ""))
+        if name not in failpoints.KNOWN_SITES:
+            return wire.T_ERR, {"error": f"unknown failpoint {name!r}"}
+        if payload.get("disarm"):
+            failpoints.disarm(name)
+            return wire.T_ACK, {"disarmed": name}
+        failpoints.arm(
+            name,
+            mode=str(payload.get("mode", "error")),
+            count=payload.get("count"),
+            delay_s=float(payload.get("delay_s", 0.0)),
+            probability=float(payload.get("probability", 1.0)),
+        )
+        return wire.T_ACK, {"armed": name}
+
     def h_stats(payload):
         router = state["router"]
+        ms = state["membership"]
         return wire.T_STATS_R, {
             "node_id": node_id,
             "sched": sched.stats.peek(),
@@ -250,6 +414,8 @@ def main(argv=None) -> int:
             "decisions": list(inner_banner.decisions),
             "dynamic": list(dynamic_lists.metrics()),
             "router": router.describe() if router is not None else None,
+            "membership": ms.describe() if ms is not None else None,
+            "detection": fstats.detection_snapshot()[1],
         }
 
     def h_snapshot(payload):
@@ -288,6 +454,11 @@ def main(argv=None) -> int:
             wire.T_LINES: h_lines,
             wire.T_PEER_DOWN: h_peer_down,
             wire.T_PEER_UP: h_peer_up,
+            wire.T_GOSSIP_PING: h_gossip_ping,
+            wire.T_GOSSIP_PING_REQ: h_gossip_ping_req,
+            wire.T_JOIN: h_join,
+            wire.T_LEAVE: h_leave,
+            wire.T_FAILPOINT: h_failpoint,
             wire.T_STATS: h_stats,
             wire.T_SNAPSHOT: h_snapshot,
             wire.T_SYNC: h_sync,
@@ -297,14 +468,85 @@ def main(argv=None) -> int:
         },
     ).start()
 
-    print(json.dumps(
-        {"ready": True, "node_id": node_id, "port": node.port}
-    ), flush=True)
+    if args.join:
+        # ---- automatic join: announce -> snapshot sync -> gossip ----
+        jhost, _, jport = args.join.rpartition(":")
+        jhost = jhost or "127.0.0.1"
+
+        def _rpc(ftype, payload, timeout=10.0):
+            with _socket.create_connection(
+                (jhost, int(jport)), timeout=timeout
+            ) as sock:
+                sock.settimeout(timeout)
+                wire.send_frame(sock, ftype, payload)
+                return wire.recv_frame(sock)
+
+        try:
+            rtype, joined = _rpc(wire.T_JOIN, {
+                "node_id": node_id, "host": "127.0.0.1", "port": node.port,
+            })
+            if rtype != wire.T_JOIN_R:
+                raise OSError(f"join refused: {joined}")
+            members = joined.get("members", [])
+            ring_ids = sorted(
+                str(row[0]) for row in members
+                if row[1] in (swim.ALIVE, swim.SUSPECT)
+            )
+            clients = {
+                str(row[0]): (
+                    None if str(row[0]) == node_id
+                    else _make_client(str(row[0]), row[3], row[4])
+                )
+                for row in members if str(row[0]) in ring_ids
+            }
+            router = FabricRouter(
+                node_id,
+                ConsistentHashRing(ring_ids, vnodes=args.vnodes),
+                clients, _local_submit, stats=fstats, health=health,
+                takeover_grace_ms=args.grace_ms,
+            )
+            state["router"] = router
+            ms = _start_membership(
+                router, None,
+                args.gossip_interval_ms or 250.0,
+                args.suspect_timeout_ms,
+                args.indirect_probes,
+                node.port,
+            )
+            ms.merge(members, via="join")
+            # warm start: the fleet's decisions, idempotently applied
+            rtype, snap = _rpc(wire.T_SNAPSHOT, {})
+            synced = 0
+            if rtype == wire.T_SNAPSHOT_R:
+                for ip, dec_name, expires, domain in snap.get(
+                    "decisions", []
+                ):
+                    dynamic_lists.update(
+                        ip, float(expires), Decision[dec_name], True, domain
+                    )
+                    synced += 1
+        except (OSError, ValueError, KeyError) as exc:
+            print(json.dumps(
+                {"ready": False, "error": f"join failed: {exc}"}
+            ), flush=True)
+            return 2
+        print(json.dumps({
+            "ready": True, "node_id": node_id, "port": node.port,
+            "joined": True, "synced": synced,
+            "members": len(members),
+        }), flush=True)
+    else:
+        print(json.dumps(
+            {"ready": True, "node_id": node_id, "port": node.port}
+        ), flush=True)
 
     try:
         while not shutdown.wait(0.2):
             pass
     finally:
+        ms = state["membership"]
+        if ms is not None:
+            ms.stop()
         if reader is not None:
             reader.stop()
         sched.stop()

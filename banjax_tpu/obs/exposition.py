@@ -253,6 +253,18 @@ def render_prometheus(
             registry.PROM_FAMILIES["banjax_fabric_takeover_duration_seconds"],
             fabric.takeover_duration,
         )
+        states = fabric.member_states_snapshot()
+        if states:
+            fam = registry.PROM_FAMILIES["banjax_fabric_membership_state"]
+            enc = {"alive": 0, "suspect": 1, "dead": 2, "left": 3}
+            for pid, state in sorted(states.items()):
+                w.sample(fam, enc.get(state, 2), {"peer": pid})
+        w.histogram(
+            registry.PROM_FAMILIES[
+                "banjax_fabric_membership_detection_seconds"
+            ],
+            fabric.detection_time,
+        )
 
     # component health: aggregate + one labeled gauge per component
     if health is not None:

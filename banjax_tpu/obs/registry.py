@@ -323,6 +323,36 @@ FAMILIES: List[Family] = [
     Family(HISTOGRAM, "takeover duration: peer declared dead -> journal "
            "fully replayed (s)",
            prom="banjax_fabric_takeover_duration_seconds"),
+    Family(GAUGE, "gossip membership state of the labeled peer in this "
+           "node's view (0=alive 1=suspect 2=dead 3=left)",
+           prom="banjax_fabric_membership_state", labels=("peer",)),
+    Family(COUNTER, "alive -> suspect transitions observed (direct + "
+           "indirect probes all failed, or a suspicion digest arrived)",
+           line_key="FabricMembershipSuspects",
+           prom="banjax_fabric_membership_suspects_total"),
+    Family(COUNTER, "suspicions that expired into confirmed-dead "
+           "(drives mark_dead -> journal-replay takeover)",
+           line_key="FabricMembershipConfirmedDead",
+           prom="banjax_fabric_membership_confirmed_dead_total"),
+    Family(COUNTER, "suspicions refuted by liveness evidence or an "
+           "incarnation-bumped ALIVE from the suspect itself",
+           line_key="FabricMembershipRefuted",
+           prom="banjax_fabric_membership_refuted_total"),
+    Family(COUNTER, "members joined or revived in this node's view "
+           "(gossip join announce, rejoin, refute-after-dead)",
+           line_key="FabricMembershipJoined",
+           prom="banjax_fabric_membership_joined_total"),
+    Family(COUNTER, "graceful LEFT departures observed (journal cleared "
+           "without replay — the leaver drained first)",
+           line_key="FabricMembershipLeft",
+           prom="banjax_fabric_membership_left_total"),
+    Family(COUNTER, "bytes of dedicated gossip probe traffic sent "
+           "(digest piggybacks on data-path acks ride free)",
+           line_key="FabricGossipBytes",
+           prom="banjax_fabric_gossip_bytes_total"),
+    Family(HISTOGRAM, "failure-detection latency: last liveness evidence "
+           "for a member -> its death confirmed in this node's view (s)",
+           prom="banjax_fabric_membership_detection_seconds"),
     # ---- pipeline scheduler ----
     Family(COUNTER, "lines+commands admitted into the pipeline",
            line_key="PipelineAdmittedLines",

@@ -40,6 +40,15 @@ Instrumented sites in this tree (KNOWN_SITES):
                      the connection like a torn network)
   fabric.takeover  — fabric router takeover entry (the takeover completes
                      anyway; the episode is visible in snapshot())
+  fabric.gossip.ping — membership probe send path (an injected fault makes
+                     every outgoing probe fail: the node goes deaf and its
+                     peers' indirect probes decide the outcome)
+  fabric.gossip.ack — membership probe answer path; arm with mode=sleep to
+                     fake a slow-but-alive node and drive the
+                     suspect -> refute cycle
+  fabric.membership.update — before merging a received membership digest
+                     (an injected fault drops that one update; gossip
+                     re-delivers on a later frame)
 """
 
 from __future__ import annotations
@@ -71,6 +80,9 @@ KNOWN_SITES = (
     "fabric.send",
     "fabric.recv",
     "fabric.takeover",
+    "fabric.gossip.ping",
+    "fabric.gossip.ack",
+    "fabric.membership.update",
 )
 
 MODES = ("error", "sleep")

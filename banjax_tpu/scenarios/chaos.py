@@ -39,6 +39,14 @@ PIPELINE_POINTS = (
 )
 TAILER_POINTS = PIPELINE_POINTS + ("tailer.open",)
 KAFKA_POINTS = PIPELINE_POINTS + ("kafka.read", "kafka.send")
+# membership-layer failpoints: these fire inside fabric worker
+# processes (armed over the wire via T_FAILPOINT), not on the
+# single-process runner path
+GOSSIP_POINTS = (
+    "fabric.gossip.ping",
+    "fabric.gossip.ack",
+    "fabric.membership.update",
+)
 
 
 @dataclasses.dataclass
@@ -125,3 +133,54 @@ class ChaosSchedule:
 
     def rows(self) -> List[dict]:
         return [dataclasses.asdict(ep) for ep in self.episodes]
+
+
+@dataclasses.dataclass
+class ChurnOp:
+    op: str            # kill | join | slow_node | leave
+    at_frac: float     # flood position (kill) / phase ordering hint
+    detail: dict
+    outcome: Optional[dict] = None  # filled by the executing harness
+
+
+class MembershipChurnSchedule:
+    """Seeded plan for one membership-churn episode over a fabric run.
+
+    The dryrun_fabric churn mode (fabric/harness.py, churn=True) is the
+    executor: a SIGKILL with the feed paused (detection must be gossip's
+    alone), an automatic join (T_JOIN announce + snapshot sync, no
+    restarts), a slow-node suspect/refute cycle (sleep failpoint on
+    `fabric.gossip.ack`, armed over the wire), and a planned leave
+    (drain + LEFT handback, zero shed / zero replay).  The schedule
+    contributes the seeded knobs — where in the flood the kill lands
+    and how deaf the slow node plays — so two runs with the same seed
+    churn identically, the same determinism contract ChaosSchedule
+    gives the single-process soak.
+    """
+
+    def __init__(self, seed: int,
+                 kill_frac_bounds: tuple = (0.3, 0.6),
+                 slow_delay_intervals: tuple = (2.5, 4.0)):
+        rng = random.Random(seed)
+        self.seed = seed
+        self.kill_frac = round(rng.uniform(*kill_frac_bounds), 3)
+        # the slow node answers probes after this many gossip intervals
+        # (> 1 guarantees every direct probe against it times out)
+        self.slow_delay_x = round(rng.uniform(*slow_delay_intervals), 2)
+        self.ops: List[ChurnOp] = [
+            ChurnOp("kill", self.kill_frac, {"feed_paused": True}),
+            ChurnOp("join", 1.0, {"via": "gossip announce"}),
+            ChurnOp("slow_node", 1.0,
+                    {"point": "fabric.gossip.ack",
+                     "delay_intervals": self.slow_delay_x}),
+            ChurnOp("leave", 1.0, {"graceful": True}),
+        ]
+
+    def record(self, op: str, outcome: dict) -> None:
+        for entry in self.ops:
+            if entry.op == op:
+                entry.outcome = outcome
+                return
+
+    def rows(self) -> List[dict]:
+        return [dataclasses.asdict(entry) for entry in self.ops]

@@ -1838,9 +1838,16 @@ def _fabric_mode() -> None:
     (lines shed between the kill and the successors finishing the
     journal replay, over lines fed in that window).  Every row must hold
     recall 1.0 vs the oracle; the kill rows must also prove the takeover
-    happened and duplicates were suppressed.  Knobs:
-    BENCH_FABRIC_{SHAPE,SEED,SCALE,NS}, BENCH_CPU=1 (workers always pin
-    the CPU backend themselves)."""
+    happened and duplicates were suppressed.
+
+    Churn rows (`churn_n2`/`churn_n4`) run the gossip-membership episode
+    on top: SIGKILL with the feed paused (detection is gossip's alone —
+    the kill→confirmed-dead seconds per survivor are banked as the
+    detection distribution), an automatic join with snapshot sync and no
+    fleet restart, a slow-node suspect/refute cycle, and a graceful
+    leave with zero shed / zero replay.  Knobs:
+    BENCH_FABRIC_{SHAPE,SEED,SCALE,NS,CHURN_NS}, BENCH_CPU=1 (workers
+    always pin the CPU backend themselves)."""
     from banjax_tpu.fabric.harness import run_fabric
 
     shape = os.environ.get("BENCH_FABRIC_SHAPE", "flash_crowd")
@@ -1849,6 +1856,11 @@ def _fabric_mode() -> None:
     ns = [
         int(n)
         for n in os.environ.get("BENCH_FABRIC_NS", "1,2,4").split(",")
+    ]
+    churn_ns = [
+        int(n)
+        for n in os.environ.get("BENCH_FABRIC_CHURN_NS", "2,4").split(",")
+        if n.strip()
     ]
 
     rows = {}
@@ -1879,7 +1891,43 @@ def _fabric_mode() -> None:
         }
         print(json.dumps({"arm": f"n{n}", **rows[f"n{n}"]}), flush=True)
 
-    kill_rows = [r for r in rows.values() if r["killed"]]
+    for n in churn_ns:
+        report = run_fabric(
+            n_workers=n, shape=shape, seed=seed, scale=scale, churn=True,
+        )
+        bad = [k for k, ok in report["invariants"].items() if not ok]
+        assert not bad, f"fabric churn invariants failed at n={n}: {bad}"
+        takeover = report.get("takeover") or {}
+        detect = takeover.get("detect_s") or {}
+        rows[f"churn_n{n}"] = {
+            "n_workers": n,
+            "mode": "membership_churn",
+            "killed": report["killed"],
+            "recall": report["recall"],
+            "precision": report["precision"],
+            "detection_s": detect,
+            "max_detection_s": takeover.get("max_detect_s"),
+            "suspect_timeout_s": takeover.get("suspect_timeout_s"),
+            "gossip_interval_s": takeover.get("gossip_interval_s"),
+            "takeover_window_s": takeover.get("window_s"),
+            "join_synced_decisions": report["join"]["synced_decisions"],
+            "join_wave_exactly_once": (
+                report["join"]["invariants"]["wave_exactly_once"]
+            ),
+            "refuted": report["suspect_refute"]["refuted_delta"],
+            "leave_zero_shed": (
+                report["leave"]["invariants"]["zero_shed"]
+            ),
+            "leave_zero_replay": (
+                report["leave"]["invariants"]["zero_replay"]
+            ),
+            "leave_drain_ms": report["leave"]["drain_ms"],
+        }
+        print(json.dumps(
+            {"arm": f"churn_n{n}", **rows[f"churn_n{n}"]}
+        ), flush=True)
+
+    kill_rows = [r for r in rows.values() if r.get("killed")]
     book = {
         "metric": (
             "decision fabric: lines/s vs shard count with one shard "
@@ -1897,10 +1945,13 @@ def _fabric_mode() -> None:
                 r["recall"] == 1.0 for r in rows.values()
             ),
             "max_takeover_shed_ratio": max(
-                (r["takeover_shed_ratio"] or 0.0) for r in kill_rows
+                (r.get("takeover_shed_ratio") or 0.0) for r in kill_rows
             ) if kill_rows else None,
             "max_takeover_window_s": max(
-                (r["takeover_window_s"] or 0.0) for r in kill_rows
+                (r.get("takeover_window_s") or 0.0) for r in kill_rows
+            ) if kill_rows else None,
+            "max_gossip_detection_s": max(
+                (r.get("max_detection_s") or 0.0) for r in kill_rows
             ) if kill_rows else None,
         },
     }

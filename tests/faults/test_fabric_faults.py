@@ -211,6 +211,110 @@ def test_sigkill_mid_scenario_takeover_and_rejoin_handback():
     assert report["rejoin"]["invariants"]["wave_exactly_once"]
 
 
+# ---------------------------------------------------------------------------
+# gossip failpoints (ISSUE 16 satellite 2): partitioned probes, a slow
+# node faked with an ack sleep, and a dropped membership update — each
+# armed at the REAL instrumented site over real sockets
+# ---------------------------------------------------------------------------
+
+
+def _gossip_pair(interval_ms=80.0, suspect_ms=600.0):
+    """Two SwimMembership tables backed by real FabricNodes; the probe
+    loops are NOT started — tests drive tick() by hand."""
+    from banjax_tpu.fabric.membership import SwimMembership
+
+    a = SwimMembership("wa", "127.0.0.1", 0, gossip_interval_ms=interval_ms,
+                       suspect_timeout_ms=suspect_ms, rng_seed=1)
+    b = SwimMembership("wb", "127.0.0.1", 0, gossip_interval_ms=interval_ms,
+                       suspect_timeout_ms=suspect_ms, rng_seed=2)
+    node_a = FabricNode("127.0.0.1", 0, handlers={
+        wire.T_GOSSIP_PING: a.handle_ping,
+        wire.T_GOSSIP_PING_REQ: a.handle_ping_req,
+    }).start()
+    node_b = FabricNode("127.0.0.1", 0, handlers={
+        wire.T_GOSSIP_PING: b.handle_ping,
+        wire.T_GOSSIP_PING_REQ: b.handle_ping_req,
+    }).start()
+    a._members["wa"].port = node_a.port
+    b._members["wb"].port = node_b.port
+    a.seed({"wb": ("127.0.0.1", node_b.port)})
+    b.seed({"wa": ("127.0.0.1", node_a.port)})
+    return a, node_a, b, node_b
+
+
+def test_gossip_ping_drop_suspects_then_digest_refutes_on_heal():
+    """fabric.gossip.ping armed (full partition): every outgoing probe
+    — direct AND the indirect relays — is dropped, so the target goes
+    SUSPECT.  Disarming heals the link; the next probe carries the
+    suspicion in its digest, the target refutes it by incarnation bump,
+    and the ack digest clears the suspicion at the prober."""
+    from banjax_tpu.fabric.membership import ALIVE, SUSPECT
+
+    a, node_a, b, node_b = _gossip_pair()
+    try:
+        failpoints.arm("fabric.gossip.ping")
+        a.tick()
+        assert a.status_of("wb") == SUSPECT
+        assert failpoints.fired_count("fabric.gossip.ping") >= 1
+        failpoints.disarm("fabric.gossip.ping")
+        a.tick()  # probe rides through; wb sees its own suspicion
+        assert a.status_of("wb") == ALIVE
+        assert b.describe()["incarnation"] >= 1  # the refutation bump
+        assert a.describe()["members"]["wb"]["incarnation"] >= 1
+        assert a.describe()["suspects"] == []
+    finally:
+        node_a.stop()
+        node_b.stop()
+
+
+def test_gossip_ack_sleep_fakes_slow_node_suspect_then_refute():
+    """fabric.gossip.ack armed with mode=sleep longer than the probe
+    timeout: the target is alive but answers too late, so the prober
+    suspects it — the exact slow-node shape the churn harness drives.
+    Once the failpoint is disarmed the next round refutes."""
+    from banjax_tpu.fabric.membership import ALIVE, SUSPECT
+
+    a, node_a, b, node_b = _gossip_pair(interval_ms=80.0)
+    try:
+        # probe timeout is max(0.05, interval)=0.08s; sleep well past it
+        failpoints.arm("fabric.gossip.ack", mode="sleep", delay_s=0.4)
+        a.tick()
+        assert a.status_of("wb") == SUSPECT
+        failpoints.disarm("fabric.gossip.ack")
+        deadline = threading.Event()
+        deadline.wait(0.5)  # let the slept handler threads drain
+        a.tick()
+        assert a.status_of("wb") == ALIVE
+        assert b.describe()["incarnation"] >= 1
+    finally:
+        node_a.stop()
+        node_b.stop()
+
+
+def test_membership_update_drop_healed_by_gossip_redelivery():
+    """fabric.membership.update armed once: the receiver drops exactly
+    one digest merge (it never learns about wc), then the next probe
+    re-delivers the same rumor and it lands — gossip's at-least-once
+    delivery heals a dropped update with no special-casing."""
+    from banjax_tpu.fabric.membership import ALIVE
+
+    a, node_a, b, node_b = _gossip_pair()
+    try:
+        a.merge([["wc", ALIVE, 0, "127.0.0.1", 9]])  # a alone knows wc
+        failpoints.arm("fabric.membership.update", count=1)
+        a.tick()  # b's merge of the ping digest is the one that drops
+        assert b.status_of("wc") is None
+        assert failpoints.fired_count("fabric.membership.update") == 1
+        a.tick()  # re-delivery on the next round
+        # b now knows wc (possibly already suspected: wc's address is
+        # dead, so a may have started suspecting it — the point here is
+        # that the dropped rumor arrived, not wc's health)
+        assert b.status_of("wc") is not None
+    finally:
+        node_a.stop()
+        node_b.stop()
+
+
 def test_client_stop_event_short_circuits_retries():
     stop = threading.Event()
     stop.set()

@@ -66,6 +66,69 @@ def test_two_shards_kill_one_mid_flood_then_rejoin():
     assert rejoin["newcomer_local_lines"] > 0
 
 
+def test_two_shards_membership_churn_gossip_detect_join_leave():
+    """ISSUE 16 acceptance (tier-1, ~25 s): the full churn episode on
+    two shards — SIGKILL with the feed PAUSED (detection must be
+    gossip's alone, no forwarded line involved), automatic join of a
+    brand-new worker (T_JOIN announce + snapshot sync, zero survivor
+    restarts), a slow-node suspect/refute cycle, and a planned leave
+    with zero shed / zero replay."""
+    report = run_fabric(
+        n_workers=2, shape="flash_crowd", seed=SEED, churn=True,
+    )
+    _assert_invariants(report)
+    assert report["recall"] == 1.0
+    assert report["oracle_bans"] > 0
+    assert report["fed_lines"] == report["acked_lines"]
+    takeover = report["takeover"]
+    assert takeover["mode"] == "gossip"
+    # every survivor confirmed the death within the suspect window
+    # (plus generous probe-scheduling and CI slack)
+    bound = (
+        takeover["suspect_timeout_s"]
+        + 10 * takeover["gossip_interval_s"] + 10.0
+    )
+    assert 0 < takeover["max_detect_s"] <= bound, takeover
+    # the victim's journaled lines were replayed, none lost
+    assert takeover["driver_replayed_lines"] > 0
+    join = report["join"]
+    assert join["synced_decisions"] > 0
+    assert join["joiner_local_lines"] > 0
+    assert join["wave_locals_sum"] == join["wave_lines"]
+    sr = report["suspect_refute"]
+    assert sr["suspects_delta"] >= 1 and sr["refuted_delta"] >= 1
+    leave = report["leave"]
+    assert leave["shed_leaver"] == 0 and leave["shed_rest"] == 0
+    assert leave["replayed_lines"] == 0
+    # the seeded schedule drove it and every op recorded its outcome
+    sched = {row["op"]: row for row in report["churn_schedule"]}
+    assert set(sched) == {"kill", "join", "slow_node", "leave"}
+    assert all(row["outcome"] is not None for row in sched.values())
+
+
+@pytest.mark.slow
+def test_four_shard_membership_churn_full_scale():
+    """The N=4 churn pass (-m slow): gossip-confirmed death with three
+    survivors converging independently, join/slow-node/leave on the
+    larger fleet."""
+    report = run_fabric(
+        n_workers=4, shape="flash_crowd", seed=SEED, scale=1.0,
+        churn=True,
+    )
+    _assert_invariants(report)
+    assert report["recall"] == 1.0
+    takeover = report["takeover"]
+    # all three survivors independently gossip-confirmed the death
+    assert len(takeover["detect_s"]) == 3
+    bound = (
+        takeover["suspect_timeout_s"]
+        + 10 * takeover["gossip_interval_s"] + 10.0
+    )
+    assert 0 < takeover["max_detect_s"] <= bound, takeover
+    assert report["join"]["wave_locals_sum"] == report["join"]["wave_lines"]
+    assert report["leave"]["replayed_lines"] == 0
+
+
 @pytest.mark.slow
 def test_four_shard_chaos_takeover_with_armed_takeover_failpoint():
     """The full chaos pass (-m slow): four shards, one SIGKILLed, the

@@ -69,6 +69,32 @@ def test_ring_exclusion_moves_only_the_dead_nodes_keys():
     assert {k: ring.owner(k) for k in keys} == before
 
 
+def test_ring_join_steals_only_from_successors_and_stays_balanced():
+    """Satellite 3 (ISSUE 16): inserting a node moves ONLY the keys the
+    newcomer now owns — every key it does NOT own keeps its exact old
+    owner, i.e. the joiner steals exclusively from its ring successors
+    and never shuffles ownership between pre-existing members.  The
+    post-join split also stays balanced at the production vnode count."""
+    ids = ["w0", "w1", "w2"]
+    ring = ConsistentHashRing(ids, vnodes=64)
+    grown = ConsistentHashRing(ids + ["w3"], vnodes=64)
+    keys = [f"172.16.{i >> 8}.{i & 255}" for i in range(2048)]
+    moved = 0
+    for k in keys:
+        before, after = ring.owner(k), grown.owner(k)
+        if after != before:
+            assert after == "w3", (k, before, after)
+            moved += 1
+    assert 0 < moved < len(keys)  # took some keys, not everything
+    # the joiner's share is its fair fraction of the moved mass
+    fr = grown.ownership_fractions(samples=4096)
+    assert set(fr) == {"w0", "w1", "w2", "w3"}
+    assert abs(sum(fr.values()) - 1.0) < 1e-9
+    # ownership-balance bound at vnodes=64: everyone holds between a
+    # third and twice their fair share (generous band: hash variance)
+    assert all(0.25 / 3 < f < 0.5 for f in fr.values()), fr
+
+
 def test_ring_rejects_degenerate_shapes():
     with pytest.raises(ValueError):
         ConsistentHashRing([])
@@ -351,6 +377,148 @@ def test_router_mark_alive_is_pure_membership_no_replay():
     assert d["last_takeover"]["peer"] == "w1"
 
 
+def test_router_mark_dead_is_nonblocking_and_deadline_polled():
+    """Satellite 1 (ISSUE 16): mark_dead with a nonzero grace window
+    must return immediately (the grace is a deadline, not a sleep) and
+    routing must stay live during the window; the journal replay fires
+    from the route()-entry poll once the deadline passes."""
+    now = [1000.0]
+    parked = threading.Event()  # grace thread parks here forever
+    ids = ["w0", "w1", "w2"]
+    ring = ConsistentHashRing(ids, vnodes=64)
+    local = []
+    peers = {"w0": None, "w1": _FakePeer("w1"), "w2": _FakePeer("w2")}
+    stats = FabricStats()
+    r = FabricRouter(
+        "w0", ring, peers, lambda ls: local.extend(ls) or len(ls),
+        stats=stats, takeover_grace_ms=10_000.0,
+        clock=lambda: now[0], sleep=lambda s: parked.wait(30.0),
+    )
+    try:
+        r.route(_lines(200))
+        held = list(peers["w1"].lines)
+        assert held
+        peers["w1"].dead = True
+        import time as _time
+        t0 = _time.monotonic()
+        r.mark_dead("w1", reason="test")
+        assert _time.monotonic() - t0 < 1.0  # no 10s stall
+        assert r.takeover_pending("w1")
+        assert stats.peek()["FabricTakeovers"] == 0  # replay deferred
+        # routing stays live mid-window: w1's keys reroute, nothing shed
+        out = r.route(_lines(30))
+        assert out["shed"] == 0
+        assert out["local"] + out["forwarded"] == 30
+        assert r.takeover_pending("w1")  # still inside the window
+        now[0] += 11.0  # the deadline passes
+        r.route(_lines(5))  # entry poll completes the takeover
+        assert not r.takeover_pending()
+        peek = stats.peek()
+        assert peek["FabricTakeovers"] == 1
+        assert peek["FabricReplayedLines"] == len(held)
+        assert stats.last_takeover["peer"] == "w1"
+    finally:
+        parked.set()
+
+
+def test_router_poll_completes_takeover_without_traffic():
+    """The gossip tick calls poll(): a takeover completes even when no
+    further route() call ever arrives (quiet-fleet death)."""
+    now = [0.0]
+    parked = threading.Event()
+    r, local, peers, stats = _router()
+    r._clock, r._sleep = (lambda: now[0]), (lambda s: parked.wait(30.0))
+    r.takeover_grace_s = 5.0
+    try:
+        r.route(_lines(120))
+        peers["w1"].dead = True
+        r.mark_dead("w1", reason="test")
+        r.poll()
+        assert r.takeover_pending("w1")  # deadline not reached
+        now[0] += 6.0
+        r.poll()
+        assert not r.takeover_pending()
+        assert stats.peek()["FabricTakeovers"] == 1
+    finally:
+        parked.set()
+
+
+def test_router_add_node_inserts_live_and_routes_to_joiner():
+    """add_node rebuilds the ring with the joiner included; subsequent
+    routing sends the stolen ranges to it, and a re-add of an existing
+    member degrades to mark_alive (no ring rebuild)."""
+    r, local, peers, stats = _router()
+    before_ids = r.ring.node_ids
+    owner_before = {f"10.9.{i}.1": r.ring.owner(f"10.9.{i}.1")
+                    for i in range(128)}
+    joiner = _FakePeer("w3")
+    r.add_node("w3", joiner)
+    assert "w3" in r.ring.node_ids and "w3" in r.alive
+    # exclusivity: any key that moved, moved to the joiner
+    for k, before in owner_before.items():
+        after = r.ring.owner(k)
+        assert after == before or after == "w3", (k, before, after)
+    lines = _lines(400)
+    out = r.route(lines)
+    assert out["shed"] == 0
+    assert joiner.lines  # the joiner actually owns (and receives) keys
+    assert all(r.ring.owner(ip_of_line(ln)) == "w3" for ln in joiner.lines)
+    # journal exists for the joiner: its chunks are replayable later
+    assert len(r._journal["w3"]) > 0
+    # re-adding an existing id must not rebuild the ring
+    ring_obj = r.ring
+    r.add_node("w1", peers["w1"])
+    assert r.ring is ring_obj
+
+
+def test_router_mark_left_clears_journal_no_replay_and_self_drain():
+    """A graceful leaver's journal is dropped WITHOUT replay (it
+    drained before departing — replay could only double-process); our
+    own id leaving is the pure-membership self-drain handback."""
+    r, local, peers, stats = _router()
+    r.route(_lines(300))
+    assert len(r._journal["w1"]) > 0
+    r.mark_left("w1")
+    assert "w1" not in r.alive
+    assert len(r._journal["w1"]) == 0
+    assert stats.peek()["FabricReplayedLines"] == 0
+    assert stats.peek()["FabricTakeovers"] == 0
+    assert r.describe()["peers"]["w1"]["alive"] is False
+    # the remaining traffic still routes fully (w1's keys rerouted)
+    out = r.route(_lines(50))
+    assert out["shed"] == 0
+    # self-drain: after mark_left(self) nothing is processed locally
+    local_before = len(local)
+    r.mark_left("w0")
+    assert "w0" not in r.alive
+    out = r.route(_lines(40))
+    assert out["local"] == 0 and out["shed"] == 0
+    assert len(local) == local_before
+
+
+def test_router_gossip_merge_consumes_piggybacked_digests():
+    """Forwarded-chunk acks carry membership digests; the router feeds
+    them to the installed gossip_merge hook (convergence rides the
+    data path)."""
+
+    class _GossipyPeer(_FakePeer):
+        def request(self, ftype, payload):
+            rtype, rp = super().request(ftype, payload)
+            rp["gossip"] = [["w9", "alive", 3, "127.0.0.1", 1]]
+            return rtype, rp
+
+    ids = ["w0", "w1"]
+    ring = ConsistentHashRing(ids, vnodes=64)
+    merged = []
+    r = FabricRouter(
+        "w0", ring, {"w0": None, "w1": _GossipyPeer("w1")},
+        lambda ls: len(ls), stats=FabricStats(), takeover_grace_ms=0.0,
+    )
+    r.gossip_merge = merged.append
+    r.route(_lines(64))
+    assert merged and merged[0] == [["w9", "alive", 3, "127.0.0.1", 1]]
+
+
 # ---------------------------------------------------------------------------
 # node <-> peer over real sockets
 # ---------------------------------------------------------------------------
@@ -415,6 +583,9 @@ def test_fabric_stats_peek_keys_are_all_registry_declared():
         "FabricReplicatedDecisions", "FabricReplicationErrors",
         "FabricDuplicatesSuppressed", "FabricReplicatedApplied",
         "FabricTakeovers",
+        "FabricMembershipSuspects", "FabricMembershipConfirmedDead",
+        "FabricMembershipRefuted", "FabricMembershipJoined",
+        "FabricMembershipLeft", "FabricGossipBytes",
     }
     for key in peek:
         assert registry.is_declared_line_key(key), key
@@ -434,6 +605,14 @@ def test_fabric_prom_families_exist_with_stable_names():
         "banjax_fabric_replicated_applied_total",
         "banjax_fabric_takeovers_total",
         "banjax_fabric_takeover_duration_seconds",
+        "banjax_fabric_membership_state",
+        "banjax_fabric_membership_suspects_total",
+        "banjax_fabric_membership_confirmed_dead_total",
+        "banjax_fabric_membership_refuted_total",
+        "banjax_fabric_membership_joined_total",
+        "banjax_fabric_membership_left_total",
+        "banjax_fabric_gossip_bytes_total",
+        "banjax_fabric_membership_detection_seconds",
     }
     assert expected <= set(registry.PROM_FAMILIES), (
         expected - set(registry.PROM_FAMILIES)
@@ -487,6 +666,11 @@ def test_fabric_config_keys_schema_stable():
     assert cfg.fabric_vnodes == 64
     assert cfg.fabric_send_timeout_ms == 2000.0
     assert cfg.fabric_takeover_grace_ms == 500.0
+    # ISSUE 16: gossip membership knobs (defaults keep gossip on)
+    assert cfg.fabric_gossip_interval_ms == 1000.0
+    assert cfg.fabric_suspect_timeout_ms == 3000.0
+    assert cfg.fabric_indirect_probes == 2
+    assert cfg.fabric_graceful_leave_ms == 5000.0
     good = config_from_yaml_text(RULES_YAML + """
 fabric_enabled: true
 fabric_node_id: shard-a
@@ -497,10 +681,21 @@ fabric_peers:
 fabric_vnodes: 16
 fabric_send_timeout_ms: 750
 fabric_takeover_grace_ms: 100
+fabric_gossip_interval_ms: 500
+fabric_suspect_timeout_ms: 1500
+fabric_indirect_probes: 3
+fabric_graceful_leave_ms: 2000
 """)
     assert good.fabric_enabled and good.fabric_node_id == "shard-a"
     assert good.fabric_peers["shard-b"] == "10.0.0.2:4480"
     assert good.fabric_vnodes == 16
+    assert good.fabric_gossip_interval_ms == 500.0
+    assert good.fabric_suspect_timeout_ms == 1500.0
+    assert good.fabric_indirect_probes == 3
+    assert good.fabric_graceful_leave_ms == 2000.0
+    # gossip can be disabled outright (static PR 11 fabric)
+    off = config_from_yaml_text(RULES_YAML + "\nfabric_gossip_interval_ms: 0")
+    assert off.fabric_gossip_interval_ms == 0.0
 
 
 def test_flight_recorder_bundle_gains_fabric_json(tmp_path):
@@ -537,6 +732,10 @@ def test_flight_recorder_bundle_gains_fabric_json(tmp_path):
     ("fabric_enabled: true\nfabric_node_id: a\n"
      "fabric_listen: 0.0.0.0:1\nfabric_peers:\n  b: 1.2.3.4:1",
      "missing this node's own id"),
+    ("fabric_gossip_interval_ms: 500\nfabric_suspect_timeout_ms: 400",
+     "fabric_suspect_timeout_ms"),
+    ("fabric_indirect_probes: -1", "fabric_indirect_probes"),
+    ("fabric_graceful_leave_ms: -1", "fabric_graceful_leave_ms"),
 ])
 def test_fabric_config_validation_errors(snippet, match):
     with pytest.raises(ValueError, match=match):
