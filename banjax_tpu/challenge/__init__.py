@@ -1,0 +1,22 @@
+"""Challenge plane: stateless issuance, device-batched PoW verification,
+bounded failure state (ROADMAP item 3).
+
+Three layers over the reference's challenge decision sources (the
+SHA-inverting proof-of-work at 429, the password form at 401, and the
+failed-challenge rate limiter — PAPER.md §0, sources 1/4):
+
+  * issuer.py    — signed expiring challenge cookies in the reference's
+                   exact wire format; issuance is a pure function of
+                   (secret, binding, expiry) and holds ZERO per-IP state.
+  * verifier.py  — sha-inv PoW verification with the leading-zero check
+                   batched onto the device (matcher/kernels/pow_verify.py);
+                   the pure-CPU reference verifier stays as differential
+                   oracle and breaker fallback, so accept/reject decisions
+                   are byte-identical on every path.
+  * failures.py  — per-IP failed-challenge state with the reference's
+                   fixed-window semantics, bounded by an LRU over exact
+                   entries plus sketch-gated spill/refill so 1M+ concurrent
+                   challengers cannot exhaust the host.
+  * stats.py     — leaf-module counters behind the banjax_challenge_*
+                   registry families.
+"""

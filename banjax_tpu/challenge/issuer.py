@@ -1,0 +1,46 @@
+"""Stateless challenge issuance.
+
+A challenge cookie is a PURE function of (secret, binding, expiry) —
+hmac[20] ‖ zeros[32] ‖ expiry_be8, base64'd, the reference's exact wire
+layout (crypto/challenge.py; byte-compatible with the unchanged
+client-side JS solvers).  Issuing one therefore holds zero per-IP state:
+a flash crowd of a million first-time visitors costs a million HMACs and
+nothing else.  State enters the picture only when a challenge is
+*failed* (challenge/failures.py).
+
+The decision chain's 429/401 paths route through `issue()` so every
+issuance crosses the `challenge.issue` failpoint (fault drills prove an
+issuance fault fails open through the recovery middleware, never
+wedging the worker) and lands in the banjax_challenge_issued_total
+counter.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from banjax_tpu.challenge import stats as challenge_stats
+from banjax_tpu.crypto.challenge import new_challenge_cookie_at
+from banjax_tpu.resilience import failpoints
+
+
+def issue_at(secret_key: str, expire_time_unix: int, client_binding: str) -> str:
+    """The deterministic issuance primitive — same inputs, same bytes."""
+    return new_challenge_cookie_at(secret_key, expire_time_unix, client_binding)
+
+
+def issue(
+    secret_key: str,
+    cookie_ttl_seconds: int,
+    client_binding: str,
+    now_unix: Optional[float] = None,
+) -> str:
+    """Issue one signed expiring challenge cookie (the decision chain's
+    _challenge_cookie call site, both the sha-inv 429 and password 401
+    flows)."""
+    failpoints.check("challenge.issue")
+    now = time.time() if now_unix is None else now_unix
+    cookie = issue_at(secret_key, int(now) + cookie_ttl_seconds, client_binding)
+    challenge_stats.get_stats().note_issued()
+    return cookie

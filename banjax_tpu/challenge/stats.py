@@ -1,0 +1,87 @@
+"""Challenge-plane run counters for the /metrics surfaces.
+
+A LEAF module in the scenarios/stats.py mold: obs/exposition.py and
+obs/metrics.py import it lazily, so a process that never issues or
+verifies a challenge pays one import and one lock per scrape — and the
+banjax_challenge_* families declared in obs/registry.py keep the schema
+CI-locked like every other surface.
+
+The issuer, verifier and bounded failure state publish here; totals are
+process-lifetime counters, the entries value is a point-in-time gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Tuple
+
+from banjax_tpu.obs.registry import Histogram
+
+# device dispatch sizes are small powers of two up to the queue bound
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                      256.0, 512.0, 1024.0)
+
+
+class ChallengeStats:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.issued_total = 0
+        # (result, path) -> count; result in {"accept", "reject"},
+        # path in {"cpu", "device"}
+        self._verifications: Dict[Tuple[str, str], int] = {}
+        self.verify_batch_size = Histogram(BATCH_SIZE_BUCKETS)
+        self.failure_state_entries = 0
+        self.failure_evictions_total = 0
+
+    def note_issued(self, n: int = 1) -> None:
+        with self._lock:
+            self.issued_total += n
+
+    def note_verification(self, result: str, path: str, n: int = 1) -> None:
+        key = (result, path)
+        with self._lock:
+            self._verifications[key] = self._verifications.get(key, 0) + n
+
+    def note_device_batch(self, size: int) -> None:
+        self.verify_batch_size.observe(float(size))
+
+    def note_failure_state(self, entries: int, evictions_total: int) -> None:
+        with self._lock:
+            self.failure_state_entries = int(entries)
+            self.failure_evictions_total = int(evictions_total)
+
+    def prom_snapshot(self) -> dict:
+        with self._lock:
+            verifications = dict(self._verifications)
+            return {
+                "issued_total": self.issued_total,
+                "verifications": verifications,
+                "verifications_total": sum(verifications.values()),
+                "failure_state_entries": self.failure_state_entries,
+                "failure_evictions_total": self.failure_evictions_total,
+            }
+
+    def active(self) -> bool:
+        """True once anything challenge-shaped happened in this process —
+        the render gate, so idle scrapes stay challenge-free."""
+        with self._lock:
+            return bool(
+                self.issued_total or self._verifications
+                or self.failure_state_entries or self.failure_evictions_total
+            )
+
+    def reset(self) -> None:
+        """Test isolation only."""
+        with self._lock:
+            self.issued_total = 0
+            self._verifications.clear()
+            self.verify_batch_size = Histogram(BATCH_SIZE_BUCKETS)
+            self.failure_state_entries = 0
+            self.failure_evictions_total = 0
+
+
+_stats = ChallengeStats()
+
+
+def get_stats() -> ChallengeStats:
+    return _stats

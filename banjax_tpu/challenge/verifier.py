@@ -1,0 +1,334 @@
+"""Sha-inv PoW verification: CPU wire checks, device-batched zero-bit
+counting, byte-identical decisions on every path.
+
+The wire-contract stages — base64 parse, length check, expiry compare,
+HMAC — always run on the CPU (they're cheap, branchy, and every byte is
+part of the reference contract).  Only the hot arithmetic, the
+leading-zero count of ``sha256(hmac || solution)``, is routed to the
+batched Pallas kernel (matcher/kernels/pow_verify.py).
+
+The HTTP path never blocks on the device unboundedly and never changes
+an accept/reject decision:
+
+  * requests funnel into a leader/follower micro-batch: whichever
+    worker thread reaches the queue first dispatches everything pending
+    (up to ``challenge_verify_batch_max``) in ONE kernel call and wakes
+    the followers with their per-lane counts;
+  * a full queue, an open breaker, a failed startup selftest, a device
+    fault (the ``challenge.device_verify`` failpoint drills this), or a
+    wait timeout all degrade the *caller* to the inline CPU oracle —
+    same digest, same count, same CookieError text;
+  * repeated device faults trip a breaker that holds verification on
+    the CPU until a cooldown expires, then probes half-open.
+
+``verify_sha_inv`` is the one entry the decision chain calls; the
+``challenge.verify`` failpoint at its top is the fail-open drill (a
+fault there propagates to the recovery middleware's 502-with-
+X-Accel-Redirect panic path, per the reference's nginx contract).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from banjax_tpu.challenge import stats as challenge_stats
+from banjax_tpu.crypto.challenge import (
+    CookieError,
+    count_zero_bits_from_left,
+    parse_cookie,
+    validate_expiration_and_hmac,
+)
+from banjax_tpu.resilience import failpoints
+
+logger = logging.getLogger(__name__)
+
+
+class DeviceUnavailable(Exception):
+    """Device path declined this verification — caller falls back to
+    the CPU oracle inline.  Never surfaces to HTTP."""
+
+
+class QueueFull(DeviceUnavailable):
+    pass
+
+
+def cpu_zero_bits(payload: bytes) -> int:
+    """The pure-CPU oracle: reference digest + reference count."""
+    return count_zero_bits_from_left(hashlib.sha256(payload).digest())
+
+
+class _Slot:
+    __slots__ = ("payload", "event", "bits", "error")
+
+    def __init__(self, payload: bytes) -> None:
+        self.payload = payload
+        self.event = threading.Event()
+        self.bits: Optional[int] = None
+        self.error: Optional[BaseException] = None
+
+
+class DeviceVerifier:
+    """Micro-batching front end over the pow_verify kernel with a
+    failure breaker.  Thread-safe; one per process."""
+
+    def __init__(
+        self,
+        batch_max: int = 256,
+        *,
+        interpret: Optional[bool] = None,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 30.0,
+        wait_timeout_s: float = 2.0,
+    ) -> None:
+        if batch_max < 1:
+            raise ValueError(f"batch_max must be >= 1, got {batch_max}")
+        self.batch_max = int(batch_max)
+        self._interpret = interpret
+        self._breaker_threshold = int(breaker_threshold)
+        self._breaker_cooldown_s = float(breaker_cooldown_s)
+        self._wait_timeout_s = float(wait_timeout_s)
+
+        self._lock = threading.Lock()
+        self._queue: List[_Slot] = []
+        self._dispatching = False
+
+        self._consecutive_failures = 0
+        self._open_until = 0.0
+        self._half_open_probe = False
+        self._selftest_done = False
+        self._disabled_reason: Optional[str] = None
+
+        self.dispatches = 0
+        self.lanes_verified = 0
+        self.faults = 0
+        self.queue_rejections = 0
+        self.breaker_trips = 0
+
+    # ---- health / breaker (lock held unless noted) ----
+
+    def _ensure_selftest(self) -> None:
+        """First-use differential proof vs hashlib; a mismatch disables
+        the device path for the process (scan_selftest downgrade)."""
+        if self._selftest_done:
+            return
+        self._selftest_done = True
+        try:
+            from banjax_tpu.matcher.kernels.pow_verify import (
+                _default_interpret,
+                pow_selftest,
+            )
+
+            if self._interpret is None:
+                self._interpret = _default_interpret()
+            pow_selftest(interpret=self._interpret)
+        except Exception as exc:  # noqa: BLE001 — any failure disables
+            self._disabled_reason = f"pow selftest failed: {exc}"
+            logger.warning(
+                "challenge device verifier disabled, CPU oracle only: %s",
+                exc,
+            )
+
+    def available(self) -> bool:
+        with self._lock:
+            self._ensure_selftest()
+            if self._disabled_reason is not None:
+                return False
+            if self._consecutive_failures < self._breaker_threshold:
+                return True
+            if time.monotonic() >= self._open_until and not self._half_open_probe:
+                return True  # one caller probes half-open
+            return False
+
+    def _note_ok(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            self._half_open_probe = False
+
+    def _note_failure(self) -> None:
+        with self._lock:
+            self.faults += 1
+            self._consecutive_failures += 1
+            self._half_open_probe = False
+            if self._consecutive_failures == self._breaker_threshold:
+                self.breaker_trips += 1
+                logger.warning(
+                    "challenge device breaker open after %d faults; "
+                    "CPU oracle for %.0fs",
+                    self._consecutive_failures,
+                    self._breaker_cooldown_s,
+                )
+            if self._consecutive_failures >= self._breaker_threshold:
+                self._open_until = time.monotonic() + self._breaker_cooldown_s
+
+    # ---- dispatch ----
+
+    def _device_bits(self, payloads: Sequence[bytes]) -> List[int]:
+        failpoints.check("challenge.device_verify")
+        from banjax_tpu.matcher.kernels.pow_verify import (
+            leading_zero_bits_batch,
+        )
+
+        return leading_zero_bits_batch(
+            payloads, interpret=bool(self._interpret)
+        ).tolist()
+
+    def _drain_as_leader(self) -> None:
+        stats = challenge_stats.get_stats()
+        try:
+            while True:
+                with self._lock:
+                    batch = self._queue[: self.batch_max]
+                    del self._queue[: len(batch)]
+                    if not batch:
+                        return
+                try:
+                    bits = self._device_bits([s.payload for s in batch])
+                except BaseException as exc:  # noqa: BLE001 — wake followers
+                    for slot in batch:
+                        slot.error = exc
+                        slot.event.set()
+                    self._note_failure()
+                    continue
+                for slot, b in zip(batch, bits):
+                    slot.bits = int(b)
+                    slot.event.set()
+                self._note_ok()
+                self.dispatches += 1
+                self.lanes_verified += len(batch)
+                stats.note_device_batch(len(batch))
+        finally:
+            with self._lock:
+                self._dispatching = False
+
+    def submit(self, payload: bytes) -> int:
+        """Zero-bit count for one hmac||solution payload via the device,
+        micro-batched with concurrent callers.  Raises DeviceUnavailable
+        (or subclass) when the caller should verify inline on CPU."""
+        with self._lock:
+            self._ensure_selftest()
+            if self._disabled_reason is not None:
+                raise DeviceUnavailable(self._disabled_reason)
+            if self._consecutive_failures >= self._breaker_threshold:
+                if time.monotonic() < self._open_until or self._half_open_probe:
+                    raise DeviceUnavailable("breaker open")
+                self._half_open_probe = True  # this caller is the probe
+            if len(self._queue) >= self.batch_max:
+                self.queue_rejections += 1
+                raise QueueFull(
+                    f"verification queue at bound {self.batch_max}"
+                )
+            slot = _Slot(payload)
+            self._queue.append(slot)
+            leader = not self._dispatching
+            if leader:
+                self._dispatching = True
+        if leader:
+            self._drain_as_leader()
+        if not slot.event.wait(self._wait_timeout_s):
+            raise DeviceUnavailable("device wait timeout")
+        if slot.error is not None:
+            raise DeviceUnavailable(str(slot.error))
+        assert slot.bits is not None
+        return slot.bits
+
+    def verify_batch(
+        self, payloads: Sequence[bytes]
+    ) -> List[int]:
+        """Bulk path for bench/scenario harnesses: dispatch in
+        batch_max-sized kernel calls, CPU fallback per-chunk on fault."""
+        stats = challenge_stats.get_stats()
+        out: List[int] = []
+        for i in range(0, len(payloads), self.batch_max):
+            chunk = list(payloads[i : i + self.batch_max])
+            if self.available():
+                try:
+                    bits = self._device_bits(chunk)
+                    self._note_ok()
+                    self.dispatches += 1
+                    self.lanes_verified += len(chunk)
+                    stats.note_device_batch(len(chunk))
+                    out.extend(bits)
+                    continue
+                except BaseException:  # noqa: BLE001
+                    self._note_failure()
+            out.extend(cpu_zero_bits(p) for p in chunk)
+        return out
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "dispatches": self.dispatches,
+                "lanes_verified": self.lanes_verified,
+                "faults": self.faults,
+                "queue_rejections": self.queue_rejections,
+                "breaker_trips": self.breaker_trips,
+                "disabled_reason": self._disabled_reason,
+            }
+
+
+def from_config(config) -> Optional[DeviceVerifier]:
+    """The construction seam: a device verifier when
+    challenge_device_verify is set, else None (pure-CPU reference
+    path).  Both server layouts and the workers build through here."""
+    if not getattr(config, "challenge_device_verify", False):
+        return None
+    return DeviceVerifier(
+        int(getattr(config, "challenge_verify_batch_max", 256))
+    )
+
+
+def verify_sha_inv(
+    secret_key: str,
+    cookie_string: str,
+    now_time_unix: float,
+    client_binding: str,
+    expected_zero_bits: int,
+    device: Optional[DeviceVerifier] = None,
+) -> None:
+    """The decision chain's verification entry.  Raises CookieError on
+    any invalid cookie with the reference's exact message text; the
+    device only ever computes the zero-bit count, so decisions are
+    byte-identical whichever path ran.
+
+    The ``result``/``path`` labels on
+    banjax_challenge_verifications_total record where the PoW stage
+    actually executed (wire-stage rejects are CPU by construction)."""
+    failpoints.check("challenge.verify")
+    stats = challenge_stats.get_stats()
+    try:
+        hmac_from_client, solution_bytes, expiration_bytes = parse_cookie(
+            cookie_string
+        )
+        validate_expiration_and_hmac(
+            secret_key,
+            expiration_bytes,
+            now_time_unix,
+            hmac_from_client,
+            client_binding,
+        )
+    except CookieError:
+        stats.note_verification("reject", "cpu")
+        raise
+
+    payload = hmac_from_client + solution_bytes
+    path = "cpu"
+    if device is not None and device.available():
+        try:
+            actual_zero_bits = device.submit(payload)
+            path = "device"
+        except DeviceUnavailable:
+            actual_zero_bits = cpu_zero_bits(payload)
+    else:
+        actual_zero_bits = cpu_zero_bits(payload)
+
+    if actual_zero_bits < expected_zero_bits:
+        stats.note_verification("reject", path)
+        raise CookieError(
+            f"not enough zero bits in hash: expected {expected_zero_bits}, "
+            f"found {actual_zero_bits}"
+        )
+    stats.note_verification("accept", path)

@@ -147,7 +147,21 @@ class BanjaxApp:
                 n_http_workers = 0
         self._n_http_workers = n_http_workers
         if n_http_workers == 0:
-            self.failed_challenge_states = FailedChallengeRateLimitStates()
+            # bounded when challenge_failure_state_max is set (the shm
+            # variant above carries its own fixed-slot bound + dropped
+            # counter, so the python LRU/spill tiering is single-process)
+            from banjax_tpu.challenge.failures import (
+                make_failed_challenge_states,
+            )
+
+            self.failed_challenge_states = make_failed_challenge_states(
+                config
+            )
+        # device-batched PoW verification (challenge/verifier.py):
+        # None = pure-CPU reference path, decisions identical either way
+        from banjax_tpu.challenge import verifier as challenge_verifier_mod
+
+        self.challenge_verifier = challenge_verifier_mod.from_config(config)
         self.protected_paths = PasswordProtectedPaths(config)
         self.static_lists = StaticDecisionLists(config)
         if n_http_workers > 0:
@@ -489,6 +503,7 @@ class BanjaxApp:
             fabric_getter=lambda: (
                 self.fabric.stats if self.fabric is not None else None
             ),
+            challenge_verifier=self.challenge_verifier,
         )
 
     async def _serve(self, install_signal_handlers: bool) -> None:

@@ -424,6 +424,23 @@ class Config:
     # budget for the planned-leave drain (stop owning, flush, announce
     # LEFT) before the process departs anyway
     fabric_graceful_leave_ms: float = 5000.0
+    # --- challenge plane (banjax_tpu/challenge/) ---
+    # device-batched PoW verification (challenge/verifier.py + matcher/
+    # kernels/pow_verify.py): route the sha-inv leading-zero check through
+    # the batched sha256 kernel, with the pure-CPU reference verifier as
+    # differential oracle and breaker fallback.  false = CPU-only (the
+    # reference layout; expiry+hmac always stay on the CPU wire path).
+    challenge_device_verify: bool = False
+    # max candidate solutions per device dispatch — the bound on the
+    # HTTP-path verification queue; a full queue verifies inline on the
+    # CPU oracle instead of blocking the worker
+    challenge_verify_batch_max: int = 256
+    # per-client failed-challenge state bound (challenge/failures.py):
+    # at most this many exact per-IP fixed-window entries are held, LRU
+    # beyond it with sketch-gated spill/refill — 1M+ concurrent
+    # challengers cannot exhaust the host.  0 = unbounded (the
+    # reference's dict semantics, exactly).
+    challenge_failure_state_max: int = 0
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -490,6 +507,8 @@ _SCALAR_KEYS = {
     "fabric_takeover_grace_ms": float,
     "fabric_gossip_interval_ms": float, "fabric_suspect_timeout_ms": float,
     "fabric_indirect_probes": int, "fabric_graceful_leave_ms": float,
+    "challenge_device_verify": bool, "challenge_verify_batch_max": int,
+    "challenge_failure_state_max": int,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -757,6 +776,16 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
             "config keys flightrec_keep/flightrec_provenance_records: "
             f"expected >= 1, got {cfg.flightrec_keep}/"
             f"{cfg.flightrec_provenance_records}"
+        )
+    if cfg.challenge_verify_batch_max < 1:
+        raise ValueError(
+            "config key challenge_verify_batch_max: expected >= 1, got "
+            f"{cfg.challenge_verify_batch_max}"
+        )
+    if cfg.challenge_failure_state_max < 0:
+        raise ValueError(
+            "config key challenge_failure_state_max: expected 0 (unbounded) "
+            f"or a positive entry count, got {cfg.challenge_failure_state_max}"
         )
 
     return cfg

@@ -40,15 +40,14 @@ def compute_hmac(secret_key: str, expire_time_unix: int, client_binding: str) ->
 
 
 def count_zero_bits_from_left(data: bytes) -> int:
-    """challenge_response.go:37-49."""
-    count = 0
-    for byte in data:
-        for bit_index in range(7, -1, -1):
-            if byte & (1 << bit_index) == 0:
-                count += 1
-            else:
-                return count
-    return count
+    """challenge_response.go:37-49 — leading-zero-bit count of the digest.
+
+    O(1) big-int form of the reference's per-byte/per-bit loop: the
+    leading-zero run is len*8 - bit_length of the value, identical to the
+    loop for every byte pattern (tests/unit/test_challenge_crypto.py proves
+    it exhaustively against the retained reference loop)."""
+    value = int.from_bytes(data, "big")
+    return len(data) * 8 - value.bit_length()
 
 
 def parse_cookie(cookie_string: str) -> Tuple[bytes, bytes, bytes]:
@@ -119,12 +118,24 @@ def validate_password_cookie(
         raise CookieError("bad password")
 
 
+def new_challenge_cookie_at(
+    secret_key: str, expire_time_unix: int, client_binding: str
+) -> str:
+    """Deterministic issuance primitive: the cookie is a pure function of
+    (secret, binding, expiry) — hmac[20] ‖ zeros[32] ‖ expiry_be8.  The
+    stateless issuer (banjax_tpu/challenge/issuer.py) builds on this."""
+    hmac_bytes = compute_hmac(secret_key, expire_time_unix, client_binding)
+    cookie_bytes = (
+        hmac_bytes[0:20] + b"\x00" * 32
+        + struct.pack(">Q", expire_time_unix & 0xFFFFFFFFFFFFFFFF)
+    )
+    return base64.standard_b64encode(cookie_bytes).decode()
+
+
 def new_challenge_cookie(secret_key: str, cookie_ttl_seconds: int, client_binding: str) -> str:
     """challenge_response.go:179-188 — hmac[20] ‖ zeros[32] ‖ expiry_be8."""
     expire_time = int(time.time()) + cookie_ttl_seconds
-    hmac_bytes = compute_hmac(secret_key, expire_time, client_binding)
-    cookie_bytes = hmac_bytes[0:20] + b"\x00" * 32 + struct.pack(">Q", expire_time)
-    return base64.standard_b64encode(cookie_bytes).decode()
+    return new_challenge_cookie_at(secret_key, expire_time, client_binding)
 
 
 def solve_challenge_for_testing(cookie_string: str, zero_bits: int = 10) -> str:

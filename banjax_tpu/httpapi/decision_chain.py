@@ -31,12 +31,12 @@ from typing import Dict, List, Optional, Tuple
 
 from banjax_tpu.utils import go_query_unescape
 
+from banjax_tpu.challenge import issuer as challenge_issuer
+from banjax_tpu.challenge import verifier as challenge_verifier_mod
 from banjax_tpu.config.schema import Config
 from banjax_tpu.crypto.challenge import (
     CookieError,
-    new_challenge_cookie,
     validate_password_cookie,
-    validate_sha_inv_cookie,
 )
 from banjax_tpu.resilience import failpoints
 from banjax_tpu.crypto.integrity import (
@@ -66,7 +66,7 @@ from banjax_tpu.httpapi.rewrite import (
     apply_args_to_sha_inv_page,
 )
 from banjax_tpu.ingest.reports import report_passed_failed_banned_message
-from banjax_tpu.obs import provenance
+from banjax_tpu.obs import provenance, trace
 
 log = logging.getLogger(__name__)
 
@@ -256,6 +256,11 @@ class ChainState:
     protected_paths: PasswordProtectedPaths
     failed_challenge_states: FailedChallengeRateLimitStates
     banner: BannerInterface
+    # optional device-batched PoW verifier (challenge/verifier.py);
+    # None = the pure-CPU reference path, decisions identical either way
+    challenge_verifier: Optional[
+        challenge_verifier_mod.DeviceVerifier
+    ] = None
 
 
 # --------------------------------------------------------- response helpers
@@ -372,8 +377,9 @@ def _challenge_cookie(
     config: Config, req: RequestInfo, resp: Response, cookie_name: str,
     cookie_ttl_seconds: int, secret: str, set_domain_scope: bool,
 ) -> None:
-    """http_server.go:388-404."""
-    new_cookie = new_challenge_cookie(
+    """http_server.go:388-404 — routed through the stateless issuer so
+    every mint crosses the challenge.issue failpoint and counter."""
+    new_cookie = challenge_issuer.issue(
         secret, cookie_ttl_seconds, _get_user_agent_or_ip(config, req)
     )
     domain_scope = req.requested_host if set_domain_scope else ""
@@ -453,39 +459,49 @@ def send_or_validate_sha_challenge(
     integrity_cookie = req.cookie(INTEGRITY_CHECK_COOKIE_NAME) or ""
     bot_score, top_factor, fingerprint = calc_bot_score_from_cookie(integrity_cookie)
 
-    if challenge_cookie is not None:
-        try:
-            validate_sha_inv_cookie(
-                config.hmac_secret, challenge_cookie, time.time(),
-                _get_user_agent_or_ip(config, req), config.sha_inv_expected_zero_bits,
-            )
-            resp = access_granted(
-                config, req, str(ShaChallengeResult.PASSED), bot_score, top_factor, fingerprint
-            )
-            report_passed_failed_banned_message(
-                config, "ip_passed_challenge", req.client_ip, req.requested_host
-            )
-            return resp, ShaChallengeResult.PASSED, RateLimitResult()
-        except CookieError:
-            sha_result = ShaChallengeResult.FAILED_BAD_COOKIE
-    else:
-        sha_result = ShaChallengeResult.FAILED_NO_COOKIE
+    # one span around validate -> fail -> ban so a challenge_failure
+    # provenance record carries the same trace id as the verification
+    # that produced it (joinable in /decisions/explain and /debug/trace).
+    # The HTTP path has no ambient pipeline span, so the span roots its
+    # own trace id; new_trace() returns 0 (span stays inert) when off.
+    tid = trace.current_trace_id() or trace.new_trace()
+    with trace.span("challenge.sha_inv", trace_id=tid,
+                    args={"ip": req.client_ip}):
+        if challenge_cookie is not None:
+            try:
+                challenge_verifier_mod.verify_sha_inv(
+                    config.hmac_secret, challenge_cookie, time.time(),
+                    _get_user_agent_or_ip(config, req),
+                    config.sha_inv_expected_zero_bits,
+                    device=state.challenge_verifier,
+                )
+                resp = access_granted(
+                    config, req, str(ShaChallengeResult.PASSED), bot_score, top_factor, fingerprint
+                )
+                report_passed_failed_banned_message(
+                    config, "ip_passed_challenge", req.client_ip, req.requested_host
+                )
+                return resp, ShaChallengeResult.PASSED, RateLimitResult()
+            except CookieError:
+                sha_result = ShaChallengeResult.FAILED_BAD_COOKIE
+        else:
+            sha_result = ShaChallengeResult.FAILED_NO_COOKIE
 
-    report_passed_failed_banned_message(
-        config, "ip_failed_challenge", req.client_ip, req.requested_host
-    )
-    if fail_action == FailAction.BLOCK:
-        rate_result = too_many_failed_challenges(state, req, "sha_inv")
-        if rate_result.exceeded:
-            report_passed_failed_banned_message(
-                config, "ip_banned", req.client_ip, req.requested_host
-            )
-            resp = access_denied(
-                config, req, "TooManyFailedChallenges", bot_score, top_factor, fingerprint
-            )
-            return resp, sha_result, rate_result
-        return sha_inv_challenge(config, req), sha_result, rate_result
-    return sha_inv_challenge(config, req), sha_result, RateLimitResult()
+        report_passed_failed_banned_message(
+            config, "ip_failed_challenge", req.client_ip, req.requested_host
+        )
+        if fail_action == FailAction.BLOCK:
+            rate_result = too_many_failed_challenges(state, req, "sha_inv")
+            if rate_result.exceeded:
+                report_passed_failed_banned_message(
+                    config, "ip_banned", req.client_ip, req.requested_host
+                )
+                resp = access_denied(
+                    config, req, "TooManyFailedChallenges", bot_score, top_factor, fingerprint
+                )
+                return resp, sha_result, rate_result
+            return sha_inv_challenge(config, req), sha_result, rate_result
+        return sha_inv_challenge(config, req), sha_result, RateLimitResult()
 
 
 def send_or_validate_password(

@@ -493,6 +493,7 @@ class FastPathServer:
             protected_paths=deps.protected_paths,
             failed_challenge_states=deps.failed_challenge_states,
             banner=deps.banner,
+            challenge_verifier=getattr(deps, "challenge_verifier", None),
         )
         resp, result = decision_for_nginx(state, info)
         if config.debug:

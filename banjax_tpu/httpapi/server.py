@@ -108,6 +108,9 @@ class ServerDeps:
     # decision-fabric counters (fabric/stats.py FabricStats) — None when
     # the fabric is off
     fabric_getter: Optional[Callable[[], object]] = None
+    # device-batched PoW verifier (challenge/verifier.py DeviceVerifier)
+    # — None = pure-CPU reference verification, decisions identical
+    challenge_verifier: Optional[object] = None
 
 
 _STANDALONE_KEY = "banjax_standalone_hdrs"
@@ -327,6 +330,7 @@ def build_app(deps: ServerDeps,
             protected_paths=deps.protected_paths,
             failed_challenge_states=deps.failed_challenge_states,
             banner=deps.banner,
+            challenge_verifier=deps.challenge_verifier,
         )
         resp, result = decision_for_nginx(state, _request_info(request))
         if config.debug:

@@ -113,6 +113,10 @@ def main(argv: Optional[list] = None) -> int:
         banner=banner,
         gin_log_file=gin_log_file,
         server_log_file=server_log_file,
+        # workers never import jax (module docstring): PoW verification
+        # stays on the CPU oracle here; the device-batched path runs in
+        # single-process serving, where the primary owns the device
+        challenge_verifier=None,
     )
     primary_sock = os.path.join(args.ctrl_dir, PRIMARY_HTTP_SOCK)
 

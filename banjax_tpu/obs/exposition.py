@@ -241,6 +241,41 @@ def render_prometheus(
                     w.sample(registry.PROM_FAMILIES[fam_name],
                              row[field], {"scenario": name})
 
+    # challenge plane (banjax_tpu/challenge/stats.py — a leaf module):
+    # issuance / verification / bounded-failure-state families, rendered
+    # only when this process touched the challenge plane
+    try:
+        from banjax_tpu.challenge.stats import get_stats as _challenge_stats
+
+        chal = _challenge_stats()
+        chal_snap = chal.prom_snapshot() if chal.active() else None
+        chal_hist = chal.verify_batch_size
+    except Exception:  # noqa: BLE001 — a leaf must not break a scrape
+        chal_snap = None
+        chal_hist = None
+    if chal_snap is not None:
+        w.sample(
+            registry.PROM_FAMILIES["banjax_challenge_issued_total"],
+            chal_snap["issued_total"],
+        )
+        fam = registry.PROM_FAMILIES["banjax_challenge_verifications_total"]
+        for (result, path), v in sorted(chal_snap["verifications"].items()):
+            w.sample(fam, v, {"result": result, "path": path})
+        w.sample(
+            registry.PROM_FAMILIES["banjax_challenge_failure_state_entries"],
+            chal_snap["failure_state_entries"],
+        )
+        w.sample(
+            registry.PROM_FAMILIES[
+                "banjax_challenge_failure_evictions_total"
+            ],
+            chal_snap["failure_evictions_total"],
+        )
+        w.histogram(
+            registry.PROM_FAMILIES["banjax_challenge_verify_batch_size"],
+            chal_hist,
+        )
+
     # multi-host fabric: per-peer liveness gauge + takeover duration
     # histogram (banjax_tpu/fabric/stats.py; scalar totals merged above)
     if fabric is not None:

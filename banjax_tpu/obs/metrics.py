@@ -77,6 +77,26 @@ def write_metrics_line(
         # multi-host decision fabric: routed/forwarded/shed line counts,
         # replication + takeover counters (banjax_tpu/fabric/stats.py)
         line.update(fabric.peek())
+    # challenge plane (banjax_tpu/challenge/stats.py — a leaf module):
+    # issuance/verification totals + bounded failure-state occupancy,
+    # present only when this process touched the challenge plane so the
+    # reference's exact key set is preserved otherwise
+    try:
+        from banjax_tpu.challenge.stats import get_stats as _challenge_stats
+
+        chal = _challenge_stats()
+        chal_snap = chal.prom_snapshot() if chal.active() else None
+    except Exception:  # noqa: BLE001 — a leaf must not break the line
+        chal_snap = None
+    if chal_snap is not None:
+        line["ChallengeIssued"] = chal_snap["issued_total"]
+        line["ChallengeVerifications"] = chal_snap["verifications_total"]
+        line["ChallengeFailureStateEntries"] = chal_snap[
+            "failure_state_entries"
+        ]
+        line["ChallengeFailureEvictions"] = chal_snap[
+            "failure_evictions_total"
+        ]
     # Kafka batches skipped for an undecodable codec (lz4/zstd — VERDICT
     # C17): surfaced only when nonzero so the reference's exact key set is
     # preserved on clean streams

@@ -435,7 +435,30 @@ FAMILIES: List[Family] = [
     Family(GAUGE, "per-component health (0 healthy / 1 degraded / 2 "
            "failed); Health_<name> on the line",
            prom="banjax_health_component_status", labels=("component",)),
+    # ---- challenge plane (banjax_tpu/challenge/) ----
+    Family(COUNTER, "challenge cookies issued (stateless signed issuance, "
+           "sha-inv + password)",
+           line_key="ChallengeIssued", prom="banjax_challenge_issued_total"),
+    Family(COUNTER, "sha-inv PoW cookie verifications by outcome and "
+           "verifying path (cpu = reference oracle, device = batched "
+           "sha256 kernel)",
+           prom="banjax_challenge_verifications_total",
+           labels=("result", "path")),
+    Family(COUNTER, "sha-inv PoW cookie verifications, all outcomes and "
+           "paths (line-only scalar of the labeled prom family)",
+           line_key="ChallengeVerifications"),
+    Family(GAUGE, "exact per-IP failed-challenge entries held by the "
+           "bounded state (LRU + sketch spill/refill tiers excluded)",
+           line_key="ChallengeFailureStateEntries",
+           prom="banjax_challenge_failure_state_entries"),
+    Family(COUNTER, "failed-challenge entries evicted from the bounded "
+           "state under challenger pressure — bounded memory, never "
+           "silent", line_key="ChallengeFailureEvictions",
+           prom="banjax_challenge_failure_evictions_total"),
     # ---- histograms (prom-only) ----
+    Family(HISTOGRAM, "device verification batch size (candidate "
+           "solutions per sha256 kernel dispatch)",
+           prom="banjax_challenge_verify_batch_size"),
     Family(HISTOGRAM, "end-to-end matcher batch latency (s)",
            prom="banjax_batch_latency_seconds"),
     Family(HISTOGRAM, "device stage (submit->collect) latency (s)",

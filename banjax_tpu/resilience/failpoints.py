@@ -49,6 +49,17 @@ Instrumented sites in this tree (KNOWN_SITES):
   fabric.membership.update — before merging a received membership digest
                      (an injected fault drops that one update; gossip
                      re-delivers on a later frame)
+  challenge.issue  — stateless issuer entry, before every cookie mint (a
+                     fault propagates to the recovery middleware's
+                     fail-open path — challenge issuance must never
+                     wedge the worker)
+  challenge.verify — sha-inv verification entry in the decision chain
+                     (same fail-open contract as challenge.issue)
+  challenge.device_verify — inside the device micro-batch dispatch: an
+                     injected fault is swallowed by the verifier, counts
+                     toward its breaker, and the caller re-verifies on
+                     the CPU oracle — accept/reject decisions are
+                     byte-identical across the drill
 """
 
 from __future__ import annotations
@@ -83,6 +94,9 @@ KNOWN_SITES = (
     "fabric.gossip.ping",
     "fabric.gossip.ack",
     "fabric.membership.update",
+    "challenge.issue",
+    "challenge.verify",
+    "challenge.device_verify",
 )
 
 MODES = ("error", "sleep")

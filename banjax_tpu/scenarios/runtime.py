@@ -50,6 +50,7 @@ from banjax_tpu.obs import flightrec as flightrec_mod
 from banjax_tpu.scenarios import oracle as oracle_mod
 from banjax_tpu.scenarios import stats as scen_stats
 from banjax_tpu.scenarios.shapes import (
+    _HOSTS,
     RUN_NOW,
     T0,
     CommandBatch,
@@ -68,6 +69,7 @@ class RecordingBanner:
 
     def __init__(self) -> None:
         self.regex_ban_logs: List[Tuple[str, str]] = []
+        self.failed_challenge_ban_logs: List[Tuple[str, str]] = []  # (ip, type)
         self.decisions: List[Tuple[str, str]] = []   # (ip, decision)
         self.ipset: set = set()
 
@@ -81,7 +83,7 @@ class RecordingBanner:
     def log_failed_challenge_ban(self, config, ip, challenge_type, host,
                                  path, threshold, user_agent, decision,
                                  method) -> None:
-        pass
+        self.failed_challenge_ban_logs.append((ip, challenge_type))
 
     def ipset_add(self, config, ip) -> None:
         self.ipset.add(ip)
@@ -196,6 +198,10 @@ class ScenarioReport:
     episodes: List[dict]
     incidents: int
     command_items: int
+    # challenge-plane loop results (challenge_storm only, else None):
+    # scripted issuance -> solve -> verify -> failure run with exact
+    # precision/recall vs the scripted solver/attacker split
+    challenge: Optional[dict] = None
 
     def ok(self) -> bool:
         return all(self.invariants.values())
@@ -518,7 +524,139 @@ class ScenarioRunner:
                 tailer_ctx["writer"].close()
             self.sched.stop()
 
-        return self._report(base, bans_before, peaks, feed_s)
+        challenge = self._challenge_loop()
+        return self._report(base, bans_before, peaks, feed_s, challenge)
+
+    # ---- challenge-plane loop (challenge_storm shape) ----
+
+    def _challenge_loop(self) -> Optional[dict]:
+        """Drive every storm client through the REAL challenge plane —
+        decision_chain's send_or_validate_sha_challenge with the
+        scenario banner as effect sink — not a simulation.  A seeded
+        fraction of clients solve the PoW cookie they were issued and
+        must pass; the rest present garbage cookies until the
+        failed-challenge rate limit bans them.  The scripted oracle is
+        exact (non-solvers ban, solvers never do), so precision/recall
+        below 1.0/1.0 is an engine bug.  All of one client's failures
+        land inside a single rate-limit interval — the regime where the
+        bounded failure state's drops can only DELAY a ban
+        (challenge/failures.py), never un-ban or misban."""
+        sc = self.scenario
+        n_storm = int(sc.notes.get("storm_ips") or 0)
+        if not n_storm:
+            return None
+        import random as random_mod
+
+        from banjax_tpu.challenge import verifier as challenge_verifier_mod
+        from banjax_tpu.challenge.failures import make_failed_challenge_states
+        from banjax_tpu.crypto.challenge import solve_challenge_for_testing
+        from banjax_tpu.decisions.model import FailAction
+        from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+        from banjax_tpu.httpapi.decision_chain import (
+            ChainState,
+            RequestInfo,
+            ShaChallengeResult,
+            send_or_validate_sha_challenge,
+        )
+        from banjax_tpu.httpapi.rewrite import CHALLENGE_COOKIE_NAME
+
+        cfg = self.cfg
+        # the shared scenario ruleset carries no challenge-plane keys:
+        # fill in deterministic storm defaults (cfg_overrides still wins
+        # — build_engine applied them before we got here)
+        if not cfg.hmac_secret:
+            cfg.hmac_secret = f"scenario-secret-{sc.seed}"
+        if cfg.sha_inv_expected_zero_bits <= 0:
+            cfg.sha_inv_expected_zero_bits = 8  # ~256 hashes per solve
+        if cfg.sha_inv_cookie_ttl_seconds <= 0:
+            cfg.sha_inv_cookie_ttl_seconds = 60
+        if cfg.too_many_failed_challenges_threshold <= 0:
+            cfg.too_many_failed_challenges_threshold = 3
+        if cfg.too_many_failed_challenges_interval_seconds <= 0:
+            cfg.too_many_failed_challenges_interval_seconds = 30
+
+        fc_states = make_failed_challenge_states(cfg)
+        device = challenge_verifier_mod.from_config(cfg)
+        state = ChainState(
+            config=cfg,
+            static_lists=StaticDecisionLists(cfg),
+            dynamic_lists=self.dynamic_lists,
+            protected_paths=PasswordProtectedPaths(cfg),
+            failed_challenge_states=fc_states,
+            banner=self.banner,
+            challenge_verifier=device,
+        )
+        rng = random_mod.Random(sc.seed ^ 0x57012)
+        solver_fraction = float(sc.notes.get("solver_fraction", 0.25))
+        threshold = cfg.too_many_failed_challenges_threshold
+        bans_before = len(self.banner.failed_challenge_ban_logs)
+        solvers: set = set()
+        attackers: set = set()
+        solver_passes = 0
+        for k in range(n_storm):
+            ip = f"10.5.{(k >> 8) & 0xFF}.{k & 0xFF}"
+            req = RequestInfo(
+                client_ip=ip,
+                requested_host=_HOSTS[1],
+                requested_path="/checkout",
+                client_user_agent=f"ChallengeBot-{k}/2.{k % 5}",
+            )
+            if rng.random() < solver_fraction:
+                solvers.add(ip)
+                # first visit has no cookie: the real 429 issuance path
+                resp, _, _ = send_or_validate_sha_challenge(
+                    state, req, FailAction.BLOCK
+                )
+                issued = next(
+                    c.value for c in resp.cookies
+                    if c.name == CHALLENGE_COOKIE_NAME
+                )
+                solved = solve_challenge_for_testing(
+                    issued, cfg.sha_inv_expected_zero_bits
+                )
+                req2 = dataclasses.replace(
+                    req, cookies={CHALLENGE_COOKIE_NAME: solved}
+                )
+                _, result, _ = send_or_validate_sha_challenge(
+                    state, req2, FailAction.BLOCK
+                )
+                if result == ShaChallengeResult.PASSED:
+                    solver_passes += 1
+            else:
+                attackers.add(ip)
+                # garbage cookies until the rate limit trips the ban
+                for _ in range(threshold + 1):
+                    reqk = dataclasses.replace(
+                        req, cookies={CHALLENGE_COOKIE_NAME: "!bogus!"}
+                    )
+                    _, _, rate = send_or_validate_sha_challenge(
+                        state, reqk, FailAction.BLOCK
+                    )
+                    if rate.exceeded:
+                        break
+        banned = {
+            ip for ip, _ in
+            self.banner.failed_challenge_ban_logs[bans_before:]
+        }
+        tp = len(banned & attackers)
+        precision = tp / len(banned) if banned else 1.0
+        recall = tp / len(attackers) if attackers else 1.0
+        limit = int(getattr(cfg, "challenge_failure_state_max", 0) or 0)
+        return {
+            "storm_clients": n_storm,
+            "solvers": len(solvers),
+            "solver_passes": solver_passes,
+            "attackers": len(attackers),
+            "banned": len(banned),
+            "ban_precision": round(precision, 6),
+            "ban_recall": round(recall, 6),
+            "verify_path": "device" if device is not None else "cpu",
+            "failure_state_entries": len(fc_states),
+            "failure_state_max": limit,
+            "failure_state_bounded": (
+                limit == 0 or len(fc_states) <= limit
+            ),
+        }
 
     # ---- tailer-fed mode ----
 
@@ -584,7 +722,8 @@ class ScenarioRunner:
     # ---- reporting ----
 
     def _report(self, base: dict, bans_before: int,
-                peaks: Dict[str, float], feed_s: float) -> ScenarioReport:
+                peaks: Dict[str, float], feed_s: float,
+                challenge: Optional[dict] = None) -> ScenarioReport:
         sc = self.scenario
         peek = self.sched.stats.peek()
 
@@ -628,6 +767,14 @@ class ScenarioRunner:
             invariants["bundle_per_episode"] = all(
                 ep.bundle for ep in self.chaos.episodes
             )
+        if challenge is not None and not chaotic:
+            invariants["challenge_ban_exact"] = (
+                challenge["ban_precision"] == 1.0
+                and challenge["ban_recall"] == 1.0
+            )
+            invariants["challenge_state_bounded"] = (
+                challenge["failure_state_bounded"]
+            )
 
         episodes = self.chaos.rows() if chaotic else []
         report = ScenarioReport(
@@ -665,6 +812,7 @@ class ScenarioRunner:
                 self.flightrec.incident_count if self.flightrec else 0
             ),
             command_items=self._commands_handled,
+            challenge=challenge,
         )
         scen_stats.get_stats().note_run(
             sc.name,

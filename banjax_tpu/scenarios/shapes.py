@@ -287,7 +287,11 @@ def challenge_storm(seed: int, scale: float = 1.0) -> Scenario:
     """Challenge-failure storm: a crowd hammering the challenge-decision
     rule past its threshold — the reference's repeated-challenge-failure
     shape expressed as tailer traffic.  Every storm IP must draw
-    (repeated) challenge decisions."""
+    (repeated) challenge decisions.  The runner then pushes the same
+    clients through the real challenge plane (issue -> solve -> verify
+    -> failure state): a seeded `solver_fraction` of them solve the PoW
+    cookie and pass, the rest fail until the failed-challenge rate
+    limit bans them (runtime.ScenarioRunner._challenge_loop)."""
     rng = random.Random(seed)
     n_storm = max(8, int(48 * scale))
     timed = [_benign_line(rng, 0.0, SPAN_S) for _ in range(n_storm * 8)]
@@ -300,7 +304,7 @@ def challenge_storm(seed: int, scale: float = 1.0) -> Scenario:
                                    ua)))
     return _scenario(
         "challenge_storm", seed, scale, _chunked(timed),
-        notes={"storm_ips": n_storm},
+        notes={"storm_ips": n_storm, "solver_fraction": 0.25},
     )
 
 

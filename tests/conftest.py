@@ -109,6 +109,22 @@ def _leak_guard(request):
         )
 
 
+@pytest.fixture(autouse=True)
+def _challenge_stats_isolation():
+    """The challenge-plane counters are a process singleton
+    (banjax_tpu/challenge/stats.py); once active they add Challenge*
+    keys to the metrics line and banjax_challenge_* families to
+    /metrics.  Reset after every test so the reference-schema tests see
+    a challenge-quiet process regardless of ordering."""
+    yield
+    try:
+        from banjax_tpu.challenge.stats import get_stats
+
+        get_stats().reset()
+    except Exception:  # noqa: BLE001 — isolation must never fail a test
+        pass
+
+
 @pytest.fixture()
 def app_factory(tmp_path, monkeypatch):
     """Shared standalone-server bootstrap (banjax_base_test.go:32-81

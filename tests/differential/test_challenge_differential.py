@@ -1,0 +1,276 @@
+"""Differential proofs for the challenge plane (README "Challenge
+plane"): the device-batched PoW verifier is byte-identical to the pure
+CPU reference on the full request surface.
+
+  * the SAME scripted request stream — solved cookies, under-target
+    solutions, expired cookies, torn cookies, wrong-binding cookies,
+    cookieless hits — run once with the device verifier (Pallas sha256
+    kernel, interpret mode) and once with device=None must produce the
+    identical per-request (status, result, exceeded) stream AND
+    byte-identical ban-log lines from the REAL effectors Banner;
+  * the bounded failure state (challenge/failures.py) slotted in for the
+    reference's unbounded dict changes nothing on the same stream;
+  * verify_sha_inv raises the reference's exact CookieError text for
+    every reject, device or not (the crypto oracle is
+    validate_sha_inv_cookie itself);
+  * a breaker trip mid-stream (challenge.device_verify fault) degrades
+    to CPU without changing a single decision or ban-log byte.
+
+Ban-time formatting is pinned (monkeypatched) so byte comparison is
+about content, not the wall clock second the line landed on.
+"""
+
+import dataclasses
+import io
+import random
+import time
+
+import pytest
+
+from banjax_tpu.challenge.failures import make_failed_challenge_states
+from banjax_tpu.challenge.verifier import DeviceVerifier, cpu_zero_bits, verify_sha_inv
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.crypto.challenge import (
+    CookieError,
+    new_challenge_cookie_at,
+    solve_challenge_for_testing,
+    validate_sha_inv_cookie,
+)
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.model import FailAction
+from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.effectors.banner import Banner
+from banjax_tpu.httpapi.decision_chain import (
+    ChainState,
+    RequestInfo,
+    send_or_validate_sha_challenge,
+)
+from banjax_tpu.httpapi.rewrite import CHALLENGE_COOKIE_NAME
+from banjax_tpu.resilience import failpoints
+
+SECRET = "differential-secret"
+ZERO_BITS = 8          # cheap deterministic solves (~256 hashes each)
+THRESHOLD = 2
+HOST = "diff.example"
+
+CONFIG_YAML = f"""
+regexes_with_rates: []
+too_many_failed_challenges_interval_seconds: 120
+too_many_failed_challenges_threshold: {THRESHOLD}
+sha_inv_cookie_ttl_seconds: 300
+sha_inv_expected_zero_bits: {ZERO_BITS}
+hmac_secret: {SECRET}
+disable_kafka: true
+"""
+
+
+@pytest.fixture(autouse=True)
+def _pin_ban_time(monkeypatch):
+    """Both runs of a differential must serialize the same timestring;
+    the comparison is about content, not which second each run ran in."""
+    monkeypatch.setattr(
+        "banjax_tpu.effectors.banner._format_ban_time",
+        lambda unix_seconds: "2026-01-01T00:00:00",
+    )
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+
+
+def _solve_below_target(cookie: str) -> str:
+    """First brute-force counter whose hash has FEWER leading zero bits
+    than the target — the not-enough-zero-bits reject, deterministically."""
+    import base64
+
+    raw = bytearray(base64.standard_b64decode(cookie))
+    for counter in range(1 << 20):
+        raw[44:52] = counter.to_bytes(8, "big")
+        if cpu_zero_bits(bytes(raw[0:52])) < ZERO_BITS:
+            return base64.standard_b64encode(bytes(raw)).decode()
+    raise AssertionError("no under-target solution found")
+
+
+def _scripted_requests(seed: int, n_clients: int = 24):
+    """The full reject surface as one interleaved request stream.
+    Failing clients repeat past the ban threshold so the ban-log (the
+    byte-identity target) is non-vacuous."""
+    rng = random.Random(seed)
+    now = int(time.time())
+    kinds = ("solved", "under_target", "expired", "torn",
+             "wrong_binding", "no_cookie")
+    stream = []
+    for k in range(n_clients):
+        ip = f"77.0.{k >> 8}.{k & 0xFF}"
+        kind = kinds[k % len(kinds)]
+        fresh = new_challenge_cookie_at(SECRET, now + 300, ip)
+        if kind == "solved":
+            cookie = solve_challenge_for_testing(fresh, ZERO_BITS)
+            repeats = 1
+        elif kind == "under_target":
+            cookie = _solve_below_target(fresh)
+            repeats = THRESHOLD + 1
+        elif kind == "expired":
+            stale = new_challenge_cookie_at(SECRET, now - 10, ip)
+            cookie = solve_challenge_for_testing(stale, ZERO_BITS)
+            repeats = THRESHOLD + 1
+        elif kind == "torn":
+            cookie = solve_challenge_for_testing(fresh, ZERO_BITS)[:40]
+            repeats = THRESHOLD + 1
+        elif kind == "wrong_binding":
+            other = new_challenge_cookie_at(SECRET, now + 300, "8.8.8.8")
+            cookie = solve_challenge_for_testing(other, ZERO_BITS)
+            repeats = THRESHOLD + 1
+        else:  # no_cookie
+            cookie = None
+            repeats = THRESHOLD + 1
+        for _ in range(repeats):
+            cookies = {} if cookie is None else {CHALLENGE_COOKIE_NAME: cookie}
+            stream.append(RequestInfo(
+                client_ip=ip, requested_host=HOST, requested_path="/login",
+                client_user_agent=f"DiffBot-{k}", cookies=cookies,
+            ))
+    rng.shuffle(stream)  # interleave clients; same order for every run
+    return stream
+
+
+def _run_stream(requests, device, cfg=None):
+    """One full pass of the stream through the REAL chain stage with the
+    REAL Banner writing to a buffer; returns (per-request outcomes,
+    ban-log bytes, final window-state rendering)."""
+    cfg = cfg if cfg is not None else config_from_yaml_text(CONFIG_YAML)
+    dyn = DynamicDecisionLists(start_sweeper=False)
+    ban_log = io.StringIO()
+    banner = Banner(dyn, ban_log, io.StringIO(), ipset_instance=None)
+    state = ChainState(
+        config=cfg,
+        static_lists=StaticDecisionLists(cfg),
+        dynamic_lists=dyn,
+        protected_paths=PasswordProtectedPaths(cfg),
+        failed_challenge_states=make_failed_challenge_states(cfg),
+        banner=banner,
+        challenge_verifier=device,
+    )
+    outcomes = []
+    for req in requests:
+        resp, result, rate = send_or_validate_sha_challenge(
+            state, req, FailAction.BLOCK
+        )
+        outcomes.append(
+            (req.client_ip, resp.status, int(result), rate.exceeded)
+        )
+    return outcomes, ban_log.getvalue(), state.failed_challenge_states.format_states()
+
+
+def _strip_intervals(states_text: str) -> list:
+    """format_states minus the per-run interval_start timestamps (wall
+    clock ns differ between two sequential runs by construction)."""
+    out = []
+    for line in states_text.splitlines():
+        ip, _, rest = line.partition(",: interval_start: ")
+        out.append((ip, rest.split("num hits: ")[1]))
+    return out
+
+
+def test_device_and_cpu_runs_are_byte_identical():
+    """The headline differential: device-batched PoW vs pure CPU on the
+    same scripted stream — same statuses, same results, same exceeded
+    flags, byte-identical ban-log lines, same final hit counts."""
+    requests = _scripted_requests(seed=11)
+    device = DeviceVerifier(batch_max=4, interpret=True)
+
+    dev_out, dev_log, dev_states = _run_stream(requests, device)
+    cpu_out, cpu_log, cpu_states = _run_stream(requests, None)
+
+    assert dev_out == cpu_out
+    assert dev_log == cpu_log                       # byte identity
+    assert _strip_intervals(dev_states) == _strip_intervals(cpu_states)
+    # non-vacuous: accepts happened, bans happened, on the device path
+    assert any(status == 200 for _, status, _, _ in dev_out)
+    assert '"rule_type":"failed_challenge"' in dev_log
+    assert '"trigger":"failed challenge sha_inv"' in dev_log
+    counters = device.counters()
+    assert counters["dispatches"] > 0 and counters["faults"] == 0
+
+
+def test_bounded_failure_state_changes_nothing_on_this_stream():
+    """The bounded drop-in vs the reference dict, device path on both:
+    with the cap above the distinct-client count (no forced drops — the
+    only permitted divergence source) everything is identical."""
+    requests = _scripted_requests(seed=13)
+    bounded_cfg = config_from_yaml_text(CONFIG_YAML)
+    bounded_cfg.challenge_failure_state_max = 1024
+
+    ref_out, ref_log, ref_states = _run_stream(
+        requests, DeviceVerifier(batch_max=8, interpret=True)
+    )
+    b_out, b_log, b_states = _run_stream(
+        requests, DeviceVerifier(batch_max=8, interpret=True), cfg=bounded_cfg
+    )
+
+    assert b_out == ref_out
+    assert b_log == ref_log
+    # the LRU tier renders in recency order, the reference dict in
+    # insertion order — same (ip, hits) content either way
+    assert sorted(_strip_intervals(b_states)) == sorted(
+        _strip_intervals(ref_states)
+    )
+
+
+def test_verify_sha_inv_reject_text_matches_crypto_oracle_exactly():
+    """Every reject raises the reference's exact CookieError text —
+    device path, CPU path, and the crypto oracle agree byte for byte."""
+    now = int(time.time())
+    device = DeviceVerifier(batch_max=4, interpret=True)
+    fresh = new_challenge_cookie_at(SECRET, now + 300, "1.2.3.4")
+    cases = [
+        solve_challenge_for_testing(fresh, ZERO_BITS),        # accept
+        _solve_below_target(fresh),                           # zero bits
+        solve_challenge_for_testing(
+            new_challenge_cookie_at(SECRET, now - 5, "1.2.3.4"), ZERO_BITS
+        ),                                                    # expired
+        fresh[:40],                                           # torn
+        "@@not-base64@@",                                     # bad b64
+        solve_challenge_for_testing(
+            new_challenge_cookie_at(SECRET, now + 300, "9.9.9.9"), ZERO_BITS
+        ),                                                    # bad hmac
+    ]
+    for cookie in cases:
+        results = []
+        for verifier in (
+            lambda c: verify_sha_inv(SECRET, c, time.time(), "1.2.3.4",
+                                     ZERO_BITS, device=device),
+            lambda c: verify_sha_inv(SECRET, c, time.time(), "1.2.3.4",
+                                     ZERO_BITS, device=None),
+            lambda c: validate_sha_inv_cookie(SECRET, c, time.time(),
+                                              "1.2.3.4", ZERO_BITS),
+        ):
+            try:
+                verifier(cookie)
+                results.append(("accept", ""))
+            except CookieError as e:
+                results.append(("reject", str(e)))
+        assert results[0] == results[1] == results[2], cookie
+
+
+def test_breaker_trip_mid_stream_keeps_decisions_identical():
+    """challenge.device_verify faults trip the breaker mid-stream; the
+    degraded run must match the pure-CPU run decision for decision and
+    byte for byte in the ban log — resilience never changes an answer."""
+    requests = _scripted_requests(seed=17)
+    cpu_out, cpu_log, _ = _run_stream(requests, None)
+
+    device = DeviceVerifier(
+        batch_max=4, interpret=True, breaker_threshold=3,
+        breaker_cooldown_s=3600.0,
+    )
+    failpoints.arm("challenge.device_verify", mode="error")
+    try:
+        dev_out, dev_log, _ = _run_stream(requests, device)
+    finally:
+        failpoints.disarm()
+
+    assert dev_out == cpu_out
+    assert dev_log == cpu_log
+    assert not device.available()  # the breaker actually opened
+    assert device.counters()["breaker_trips"] >= 1

@@ -1,0 +1,126 @@
+"""Challenge-plane failpoints, each at its real site with its real
+contract (resilience/failpoints.py KNOWN_SITES):
+
+  * challenge.issue / challenge.verify sit on the HTTP request path and
+    FAIL OPEN — a fault propagates out of decision_for_nginx and becomes
+    the reference's 500 + X-Accel-Redirect: @fail_open recovery, on both
+    HTTP layouts, and the app serves normally once disarmed;
+  * challenge.device_verify is SWALLOWED — the verifier falls back to
+    the CPU oracle, the breaker opens after the threshold, decisions
+    never change, and the device path recovers through the half-open
+    probe after the cooldown.
+"""
+
+import time
+from pathlib import Path
+
+import pytest
+import requests
+
+from banjax_tpu.challenge.verifier import DeviceVerifier, verify_sha_inv
+from banjax_tpu.crypto.challenge import (
+    new_challenge_cookie_at,
+    solve_challenge_for_testing,
+)
+from banjax_tpu.resilience import failpoints
+
+BASE = "http://localhost:8081"
+_FIXTURES = Path(__file__).resolve().parent.parent / "fixtures"
+
+SECRET = "fault-secret"
+ZERO_BITS = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+
+
+def _start(app_factory, tmp_path, fast_path: bool) -> None:
+    custom = tmp_path / "banjax-config-challenge-faults.yaml"
+    custom.write_text(
+        (_FIXTURES / "banjax-config-test.yaml").read_text()
+        + f"\nhttp_fast_path: {str(fast_path).lower()}\ndisable_kafka: true\n"
+    )
+    app_factory(str(custom))
+
+
+def _challenge_request(cookies=None):
+    # 8.8.8.8 is challenge-listed in the fixture's global lists, so this
+    # request rides the sha_inv issuance/verification path
+    return requests.get(
+        f"{BASE}/auth_request", params={"path": "/x"},
+        headers={"X-Client-IP": "8.8.8.8"}, cookies=cookies or {},
+        timeout=5,
+    )
+
+
+@pytest.mark.parametrize("fast_path", [True, False], ids=["fastserve", "aiohttp"])
+def test_issue_fault_fails_open_then_recovers(app_factory, tmp_path, fast_path):
+    _start(app_factory, tmp_path, fast_path)
+
+    failpoints.arm("challenge.issue")
+    r = _challenge_request()
+    assert r.status_code == 500
+    assert r.headers.get("X-Accel-Redirect") == "@fail_open"
+    assert "challenge.issue" in r.headers.get("X-Banjax-Error", "")
+
+    failpoints.disarm("challenge.issue")
+    r = _challenge_request()
+    assert r.status_code == 429  # the challenge page, cookie attached
+    assert "deflect_challenge3" in r.cookies
+
+
+@pytest.mark.parametrize("fast_path", [True, False], ids=["fastserve", "aiohttp"])
+def test_verify_fault_fails_open_then_recovers(app_factory, tmp_path, fast_path):
+    _start(app_factory, tmp_path, fast_path)
+
+    # the verify failpoint sits ahead of cookie parsing: any presented
+    # cookie reaches it
+    failpoints.arm("challenge.verify")
+    r = _challenge_request(cookies={"deflect_challenge3": "whatever"})
+    assert r.status_code == 500
+    assert r.headers.get("X-Accel-Redirect") == "@fail_open"
+    assert "challenge.verify" in r.headers.get("X-Banjax-Error", "")
+
+    failpoints.disarm("challenge.verify")
+    r = _challenge_request(cookies={"deflect_challenge3": "whatever"})
+    assert r.status_code == 429  # a bad cookie is a fresh challenge, not a 500
+
+
+def test_device_verify_fault_is_swallowed_and_breaker_recovers():
+    """The device failpoint never reaches a caller: every verification
+    during the outage answers from the CPU oracle, the breaker opens at
+    the threshold, and one half-open probe restores the device path
+    after the cooldown."""
+    device = DeviceVerifier(
+        batch_max=4, interpret=True, breaker_threshold=3,
+        breaker_cooldown_s=0.2,
+    )
+    cookie = solve_challenge_for_testing(
+        new_challenge_cookie_at(SECRET, int(time.time()) + 300, "5.5.5.5"),
+        ZERO_BITS,
+    )
+
+    failpoints.arm("challenge.device_verify", mode="error")
+    try:
+        for _ in range(6):
+            # accepts keep flowing throughout the injected outage
+            verify_sha_inv(SECRET, cookie, time.time(), "5.5.5.5",
+                           ZERO_BITS, device=device)
+    finally:
+        failpoints.disarm("challenge.device_verify")
+
+    counters = device.counters()
+    assert counters["faults"] >= 3
+    assert counters["breaker_trips"] >= 1
+    assert not device.available()
+
+    # past the cooldown the half-open probe runs on the device again
+    time.sleep(0.25)
+    assert device.available()
+    verify_sha_inv(SECRET, cookie, time.time(), "5.5.5.5",
+                   ZERO_BITS, device=device)
+    assert device.counters()["dispatches"] >= 1

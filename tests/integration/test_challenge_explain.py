@@ -1,0 +1,126 @@
+"""Challenge-plane acceptance: a ban born from a FAILED VERIFICATION
+(the full issuance -> verify -> failure path, not a bare
+too_many_failed_challenges call) shows up in /decisions/explain with the
+challenge_failure source, the sha_inv rule, and a trace id that joins
+the challenge.sha_inv verification span in /debug/trace — one id from
+the cookie check to the ban record."""
+
+import asyncio
+import time
+
+import pytest
+
+from banjax_tpu.challenge.failures import make_failed_challenge_states
+from banjax_tpu.challenge.verifier import DeviceVerifier
+from banjax_tpu.config.schema import config_from_yaml_text
+from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+from banjax_tpu.decisions.model import FailAction
+from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+from banjax_tpu.decisions.rate_limit import RegexRateLimitStates
+from banjax_tpu.decisions.static_lists import StaticDecisionLists
+from banjax_tpu.httpapi import server as server_mod
+from banjax_tpu.httpapi.decision_chain import (
+    ChainState,
+    RequestInfo,
+    send_or_validate_sha_challenge,
+)
+from banjax_tpu.obs import provenance, trace
+from tests.mock_banner import MockBanner
+
+CONFIG_YAML = r"""
+config_version: challenge-explain-test
+regexes_with_rates: []
+iptables_ban_seconds: 10
+kafka_brokers: [localhost:9092]
+server_log_file: /tmp/banjax-challenge-explain-test.log
+expiring_decision_ttl_seconds: 300
+too_many_failed_challenges_interval_seconds: 60
+too_many_failed_challenges_threshold: 2
+sha_inv_cookie_ttl_seconds: 300
+sha_inv_expected_zero_bits: 8
+hmac_secret: secret
+session_cookie_hmac_secret: session_secret
+disable_kafka: true
+challenge_failure_state_max: 1024
+challenge_device_verify: true
+"""
+
+IP = "44.44.44.44"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    provenance.configure(enabled=True, ring_size=512)
+    trace.configure(enabled=True, ring_size=4096)
+    yield
+    provenance.configure(enabled=True)
+    trace.configure(enabled=False)
+
+
+def test_failed_verification_ban_joins_the_challenge_span():
+    config = config_from_yaml_text(CONFIG_YAML)
+    dynamic = DynamicDecisionLists(start_sweeper=False)
+    state = ChainState(
+        config=config,
+        static_lists=StaticDecisionLists(config),
+        dynamic_lists=dynamic,
+        protected_paths=PasswordProtectedPaths(config),
+        failed_challenge_states=make_failed_challenge_states(config),
+        banner=MockBanner(dynamic),
+        challenge_verifier=DeviceVerifier(batch_max=8, interpret=True),
+    )
+    req = RequestInfo(
+        client_ip=IP, requested_host="example.com", requested_path="/",
+        client_user_agent="probe", method="GET",
+        cookies={"deflect_challenge3": "garbage-cookie"},
+    )
+    exceeded = False
+    for _ in range(3):  # threshold 2 → the 3rd failure exceeds
+        _, _, rate = send_or_validate_sha_challenge(
+            state, req, FailAction.BLOCK
+        )
+        exceeded = exceeded or rate.exceeded
+    assert exceeded
+
+    recs = [r for r in provenance.get_ledger().explain(IP)
+            if r["source"] == "challenge_failure"]
+    assert recs, "challenge-failure ban did not land in the ledger"
+    rec = recs[-1]
+    assert rec["rule"] == "failed challenge sha_inv"
+    assert rec["hits"] == 2
+    assert rec["decision"] == "IptablesBlock"
+    assert rec["trace_id"] != 0, "ban not attributed to the verify span"
+
+    spans = trace.get_tracer().snapshot()
+    joined = [s for s in spans if s["trace_id"] == rec["trace_id"]]
+    assert any(s["name"] == "challenge.sha_inv" for s in joined), (
+        "the ban's trace id does not join a challenge.sha_inv span"
+    )
+
+    # the same record served over HTTP by /decisions/explain
+    deps = server_mod.ServerDeps(
+        config_holder=type("H", (), {"get": lambda self: config})(),
+        static_lists=state.static_lists,
+        dynamic_lists=dynamic,
+        protected_paths=state.protected_paths,
+        regex_states=RegexRateLimitStates(),
+        failed_challenge_states=state.failed_challenge_states,
+        banner=state.banner,
+    )
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def go():
+        app = server_mod.build_app(deps)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/decisions/explain", params={"ip": IP})
+            assert r.status == 200
+            return await r.json()
+        finally:
+            await client.close()
+
+    payload = asyncio.run(go())
+    http_recs = [r for r in payload["records"]
+                 if r["source"] == "challenge_failure"]
+    assert http_recs and http_recs[-1]["trace_id"] == rec["trace_id"]

@@ -71,6 +71,39 @@ def test_benign_scenario_zero_bans_on_both_fused_protocols():
         assert not any(rep.slo_breached.values()), mode
 
 
+def test_challenge_storm_drives_the_real_challenge_plane(tmp_path):
+    """challenge_storm's second act: every storm client goes through the
+    REAL issuance -> solve -> verify -> failure loop (decision_chain +
+    challenge/*), not a simulation.  Scripted solvers must all pass,
+    every non-solver must ban (exact precision/recall vs the scripted
+    split), the bounded failure state must hold its cap with zero
+    recall loss, and the eviction storm must leave a loadable
+    flight-recorder bundle."""
+    rep = ScenarioRunner(
+        generate("challenge_storm", SEED, scale=0.25),
+        flightrec_dir=str(tmp_path / "flightrec"),
+        # cap far below the attacker count so the LRU + spill machinery
+        # is actually on trial during the bans
+        cfg_overrides={"challenge_failure_state_max": 4},
+    ).run()
+    _assert_invariants(rep)  # includes challenge_ban_exact + bounded
+    ch = rep.challenge
+    assert ch is not None
+    assert ch["solvers"] > 0 and ch["attackers"] > 0
+    assert ch["solver_passes"] == ch["solvers"]
+    assert ch["banned"] == ch["attackers"]
+    assert ch["ban_precision"] == 1.0 and ch["ban_recall"] == 1.0
+    assert ch["failure_state_entries"] <= 4
+    # the storm's eviction pressure left at least one complete bundle
+    assert rep.incidents >= 1
+    fdir = str(tmp_path / "flightrec")
+    bundles = [n for n in os.listdir(fdir) if not n.startswith(".")]
+    assert bundles
+    with open(os.path.join(fdir, bundles[0], "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["reason"]
+
+
 def test_command_flood_drains_every_command_in_take_max_batches():
     rep = ScenarioRunner(generate("command_flood", SEED, scale=0.3)).run()
     _assert_invariants(rep)

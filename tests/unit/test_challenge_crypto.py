@@ -10,12 +10,27 @@ from banjax_tpu.crypto.challenge import (
     compute_hmac,
     count_zero_bits_from_left,
     new_challenge_cookie,
+    new_challenge_cookie_at,
     parse_cookie,
     solve_challenge_for_testing,
     validate_password_cookie,
     validate_sha_inv_cookie,
 )
 import hashlib
+import struct
+
+
+def _count_zero_bits_reference(data: bytes) -> int:
+    """The reference's per-byte/per-bit loop (challenge_response.go:37-49),
+    retained verbatim as the oracle for the O(1) implementation."""
+    count = 0
+    for byte in data:
+        for bit_index in range(7, -1, -1):
+            if byte & (1 << bit_index) == 0:
+                count += 1
+            else:
+                return count
+    return count
 
 
 def test_count_zero_bits():
@@ -25,6 +40,24 @@ def test_count_zero_bits():
     assert count_zero_bits_from_left(b"\x00\x80") == 8
     assert count_zero_bits_from_left(b"\x00\x00") == 16
     assert count_zero_bits_from_left(b"") == 0
+
+
+def test_count_zero_bits_exhaustive_vs_reference_loop():
+    # every single-byte pattern
+    for b0 in range(256):
+        data = bytes([b0])
+        assert count_zero_bits_from_left(data) == _count_zero_bits_reference(data), data
+    # every two-byte pattern with a leading zero/low byte (the region where
+    # the count crosses the byte boundary), plus every byte behind \x00
+    for b0 in (0x00, 0x01, 0x02, 0x0F, 0x7F, 0x80, 0xFF):
+        for b1 in range(256):
+            data = bytes([b0, b1])
+            assert count_zero_bits_from_left(data) == _count_zero_bits_reference(data), data
+    # digest-shaped inputs: all-zero prefixes of every length up to 32 bytes
+    for n_zero in range(33):
+        for tail in (b"", b"\x01", b"\x80", b"\xff" * 3):
+            data = b"\x00" * n_zero + tail
+            assert count_zero_bits_from_left(data) == _count_zero_bits_reference(data), data
 
 
 def test_hmac_is_deterministic_and_bound():
@@ -58,6 +91,62 @@ def test_parse_cookie_plus_to_space_workaround():
     mangled = cookie.replace("+", " ")
     # even if the proxy mangled '+' into ' ', parsing succeeds
     parse_cookie(mangled)
+
+
+def test_plus_to_space_workaround_end_to_end():
+    """A solved cookie whose base64 contains '+' must validate bit-for-bit
+    after a query-unescaping proxy turns every '+' into ' ' — and the
+    unmangled and mangled forms must parse to identical bytes."""
+    now = time.time()
+    # walk bindings until the solved cookie's base64 actually contains '+'
+    cookie = None
+    for i in range(512):
+        binding = f"10.0.0.{i}"
+        fresh = new_challenge_cookie("secret", 100, binding)
+        solved = solve_challenge_for_testing(fresh, zero_bits=4)
+        if "+" in solved:
+            cookie = (solved, binding)
+            break
+    assert cookie is not None, "no '+' in 512 cookies — b64 alphabet broken?"
+    solved, binding = cookie
+    mangled = solved.replace("+", " ")
+    assert mangled != solved
+    assert parse_cookie(mangled) == parse_cookie(solved)
+    validate_sha_inv_cookie("secret", mangled, now, binding, 4)
+
+
+def test_expiry_boundary_exact_second():
+    """`expiration_int < now` is strictly-less: a cookie validated at
+    exactly its expiry second still passes; any instant after it fails."""
+    expiry = int(time.time()) + 50
+    cookie = new_challenge_cookie_at("secret", expiry, "1.2.3.4")
+    validate_sha_inv_cookie("secret", cookie, float(expiry), "1.2.3.4", 0)
+    with pytest.raises(CookieError):
+        validate_sha_inv_cookie(
+            "secret", cookie, float(expiry) + 1e-3, "1.2.3.4", 0
+        )
+
+
+def test_expiry_eight_byte_big_endian_wraparound():
+    """The expiry field is 8 bytes big-endian: issuance masks to 64 bits, so
+    an expiry of 2^64 + t wraps to t on the wire and the HMAC is computed
+    over the wrapped value — issuance and validation stay consistent."""
+    now = time.time()
+    t_future = int(now) + 100
+    wrapped = new_challenge_cookie_at("secret", (1 << 64) + t_future, "1.2.3.4")
+    plain = new_challenge_cookie_at("secret", t_future, "1.2.3.4")
+    assert wrapped == plain  # byte-identical after the wrap
+    validate_sha_inv_cookie("secret", wrapped, now, "1.2.3.4", 0)
+    # max representable expiry (0xFF * 8) is "never expires" on the wire
+    max_expiry = (1 << 64) - 1
+    hmac_b = compute_hmac("secret", max_expiry, "1.2.3.4")
+    raw = hmac_b[0:20] + b"\x00" * 32 + struct.pack(">Q", max_expiry)
+    forever = base64.standard_b64encode(raw).decode()
+    validate_sha_inv_cookie("secret", forever, now, "1.2.3.4", 0)
+    # a wrapped-to-the-past expiry ((1<<64) + small) is rejected
+    stale = new_challenge_cookie_at("secret", (1 << 64) + 5, "1.2.3.4")
+    with pytest.raises(CookieError):
+        validate_sha_inv_cookie("secret", stale, now, "1.2.3.4", 0)
 
 
 def test_sha_inv_cookie_full_lifecycle():

@@ -272,3 +272,31 @@ def test_mega_state_tiering_keys_defaults_and_validation():
     ):
         with pytest.raises(ValueError):
             config_from_yaml_text(bad)
+
+
+def test_challenge_plane_keys_defaults_and_validation():
+    cfg = config_from_yaml_text("")
+    assert cfg.challenge_device_verify is False
+    assert cfg.challenge_verify_batch_max == 256
+    assert cfg.challenge_failure_state_max == 0  # unbounded = reference
+
+    cfg = config_from_yaml_text(
+        "challenge_device_verify: true\n"
+        "challenge_verify_batch_max: 64\n"
+        "challenge_failure_state_max: 4096\n"
+    )
+    assert cfg.challenge_device_verify is True
+    assert cfg.challenge_verify_batch_max == 64
+    assert cfg.challenge_failure_state_max == 4096
+
+    for bad in (
+        "challenge_verify_batch_max: 0",
+        "challenge_verify_batch_max: -1",
+        "challenge_failure_state_max: -1",
+        # Go yaml.v2 strictness: wrong-typed values fail the load
+        'challenge_device_verify: "yes"',
+        'challenge_verify_batch_max: "64"',
+        "challenge_failure_state_max: banana",
+    ):
+        with pytest.raises(ValueError):
+            config_from_yaml_text(bad)

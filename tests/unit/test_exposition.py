@@ -394,6 +394,94 @@ def test_single_kernel_depth_ignored_on_line_and_metrics(loaded_system):
     assert v == 1
 
 
+def test_challenge_families_render_and_declare():
+    """The ISSUE 17 families: drive the real challenge plane — stateless
+    issuance, an accepted device-path verification, a rejected one, and
+    a bounded failure state under eviction pressure — then require every
+    banjax_challenge_* family and Challenge* line key on both surfaces,
+    registry-declared, with the values the drive produced."""
+    from banjax_tpu.challenge import issuer, verifier
+    from banjax_tpu.challenge.failures import BoundedFailedChallengeStates
+    from banjax_tpu.challenge.stats import get_stats as challenge_stats
+    from banjax_tpu.crypto.challenge import (
+        CookieError,
+        solve_challenge_for_testing,
+    )
+
+    challenge_stats().reset()
+    secret, binding = "expo-secret", "5.6.7.8"
+    cookie = issuer.issue(secret, 300, binding)
+    solved = solve_challenge_for_testing(cookie, zero_bits=6)
+    dv = verifier.DeviceVerifier(batch_max=16, interpret=True)
+    now = time.time()
+    verifier.verify_sha_inv(secret, solved, now, binding, 6, device=dv)
+    with pytest.raises(CookieError):
+        verifier.verify_sha_inv(secret, solved, now, binding, 250, device=dv)
+
+    fc = BoundedFailedChallengeStates(4)
+    cfg = config_from_yaml_text(RULES_YAML)
+    for i in range(12):
+        fc.apply(f"6.6.6.{i}", cfg)
+    assert len(fc) == 4
+    assert fc.evictions_total == 8
+
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False), RegexRateLimitStates(),
+        fc,
+    )
+    fams = parse_text_format(text)
+    undeclared = [f for f in fams if f not in registry.PROM_FAMILIES]
+    assert not undeclared, undeclared
+    scalars = {
+        s[0]: s[2] for ent in fams.values() for s in ent["samples"]
+        if not s[1]
+    }
+    assert scalars["banjax_challenge_issued_total"] == 1
+    assert scalars["banjax_challenge_failure_state_entries"] == 4
+    assert scalars["banjax_challenge_failure_evictions_total"] == 8
+    verif = {
+        (s[1]["result"], s[1]["path"]): s[2]
+        for s in fams["banjax_challenge_verifications_total"]["samples"]
+    }
+    assert verif[("accept", "device")] == 1
+    assert verif[("reject", "device")] == 1
+    hist = fams["banjax_challenge_verify_batch_size"]["samples"]
+    count = [v for n, l, v in hist if n.endswith("_count")][0]
+    assert count == 2  # one dispatch per verification above
+
+    out = io.StringIO()
+    write_metrics_line(
+        out, DynamicDecisionLists(start_sweeper=False),
+        RegexRateLimitStates(), fc,
+    )
+    line = json.loads(out.getvalue())
+    for key in ("ChallengeIssued", "ChallengeVerifications",
+                "ChallengeFailureStateEntries", "ChallengeFailureEvictions"):
+        assert key in line, key
+        assert registry.is_declared_line_key(key), key
+    assert line["ChallengeIssued"] == 1
+    assert line["ChallengeVerifications"] == 2
+    assert line["ChallengeFailureStateEntries"] == 4
+    # the reference length key reports the bounded exact tier
+    assert line["LenFailedChallengeStates"] == 4
+
+
+def test_challenge_quiet_process_stays_schema_clean(loaded_system):
+    """A process that never touched the challenge plane must emit no
+    Challenge* line keys and no banjax_challenge_* families — the
+    reference's exact key set is preserved."""
+    from banjax_tpu.challenge.stats import get_stats as challenge_stats
+
+    challenge_stats().reset()
+    line = _full_line(*loaded_system)
+    assert not [k for k in line if k.startswith("Challenge")]
+    text = render_prometheus(
+        DynamicDecisionLists(start_sweeper=False), RegexRateLimitStates(),
+        FailedChallengeRateLimitStates(),
+    )
+    assert "banjax_challenge_" not in text
+
+
 def test_mega_state_families_render_and_declare():
     """The ISSUE 14 tiering families: a gated matcher whose unseen IPs
     all land BELOW the derived admission threshold (the fixture rule
