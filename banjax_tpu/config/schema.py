@@ -424,6 +424,25 @@ class Config:
     # budget for the planned-leave drain (stop owning, flush, announce
     # LEFT) before the process departs anyway
     fabric_graceful_leave_ms: float = 5000.0
+    # --- fabric wire v2 transport (fabric/peer.py LinePipe) ---
+    # frames outstanding per peer on the pipelined data path; 0 = the
+    # PR 11 synchronous per-group JSON path (the differential oracle —
+    # every forward blocks for its ack)
+    fabric_inflight_frames: int = 8
+    # binary T_LINES_V2 framing on the data path; false forces the JSON
+    # fallback even against v2-capable peers (the version handshake
+    # still negotiates down automatically against old peers)
+    fabric_wire_v2: bool = True
+    # send-side coalescing cap: routed groups pack into one data frame
+    # up to this many bytes
+    fabric_frame_max_bytes: int = 1 << 20
+    # co-located shards (loopback/same-host peer address): exchange data
+    # frames through a pair of SPSC shared-memory rings
+    # (native/shmring.c) instead of loopback TCP
+    fabric_shm_enabled: bool = False
+    # per-direction ring capacity in bytes (power of two, and must
+    # exceed fabric_frame_max_bytes — a frame is written atomically)
+    fabric_shm_ring_bytes: int = 1 << 21
     # --- challenge plane (banjax_tpu/challenge/) ---
     # device-batched PoW verification (challenge/verifier.py + matcher/
     # kernels/pow_verify.py): route the sha-inv leading-zero check through
@@ -507,6 +526,9 @@ _SCALAR_KEYS = {
     "fabric_takeover_grace_ms": float,
     "fabric_gossip_interval_ms": float, "fabric_suspect_timeout_ms": float,
     "fabric_indirect_probes": int, "fabric_graceful_leave_ms": float,
+    "fabric_inflight_frames": int, "fabric_wire_v2": bool,
+    "fabric_frame_max_bytes": int, "fabric_shm_enabled": bool,
+    "fabric_shm_ring_bytes": int,
     "challenge_device_verify": bool, "challenge_verify_batch_max": int,
     "challenge_failure_state_max": int,
 }
@@ -770,6 +792,31 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
         raise ValueError(
             "config key fabric_graceful_leave_ms: expected >= 0, got "
             f"{cfg.fabric_graceful_leave_ms}"
+        )
+    if cfg.fabric_inflight_frames < 0:
+        raise ValueError(
+            "config key fabric_inflight_frames: expected >= 0 (0 = "
+            f"synchronous JSON path), got {cfg.fabric_inflight_frames}"
+        )
+    if cfg.fabric_frame_max_bytes < 4096:
+        raise ValueError(
+            "config key fabric_frame_max_bytes: expected >= 4096, got "
+            f"{cfg.fabric_frame_max_bytes}"
+        )
+    if cfg.fabric_shm_ring_bytes & (cfg.fabric_shm_ring_bytes - 1) or \
+            cfg.fabric_shm_ring_bytes < 4096:
+        raise ValueError(
+            "config key fabric_shm_ring_bytes: expected a power of two "
+            f">= 4096, got {cfg.fabric_shm_ring_bytes}"
+        )
+    if (
+        cfg.fabric_shm_enabled
+        and cfg.fabric_shm_ring_bytes <= cfg.fabric_frame_max_bytes
+    ):
+        raise ValueError(
+            "config key fabric_shm_ring_bytes: must exceed "
+            "fabric_frame_max_bytes (a frame is ring-written atomically), "
+            f"got {cfg.fabric_shm_ring_bytes} <= {cfg.fabric_frame_max_bytes}"
         )
     if cfg.flightrec_keep < 1 or cfg.flightrec_provenance_records < 1:
         raise ValueError(

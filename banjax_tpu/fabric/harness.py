@@ -27,8 +27,19 @@ only ADD bans (precision is reported, recall is gated).
 Accounting is the fabric-wide ledger: every driver chunk is acked by a
 live worker (fed == acked), every worker satisfies
 admitted == processed + shed + drain_errors (pipeline) and
-local + forwarded + shed == received + replayed (fabric) — admitted
-work is processed or counted shed, never silently lost.
+local + forwarded + shed + replay_skipped == received + replayed
+(fabric) — admitted work is processed or counted shed, never silently
+lost.  Driver-replayed chunks carry `replay: true` so the receiving
+router skips lines whose pre-death owner is still alive (they were
+processed once already — re-routing them double-counts rate-limit hits
+and mints duplicate bans, the banked n2 precision bug).
+
+The `transport` knob picks the worker-to-worker data path: "json" is
+the PR 11 synchronous per-group path (the differential oracle), "v2"
+the pipelined binary frame path over TCP, "shm" the same frames over
+co-located shared-memory rings.  `run_forward_path` is the transport
+micro-benchmark: two shards, every line owned by the remote peer, so
+the measured rate is pure forwarding.
 """
 
 from __future__ import annotations
@@ -164,7 +175,11 @@ class FabricDryrun:
         ready_timeout_s: float = 420.0,
         settle_timeout_s: float = 120.0,
         log_dir: Optional[str] = None,
+        transport: str = "v2",
+        inflight_frames: int = 8,
     ):
+        if transport not in ("json", "v2", "shm"):
+            raise ValueError(f"unknown transport {transport!r}")
         self.schedule = None
         if churn:
             from banjax_tpu.scenarios.chaos import MembershipChurnSchedule
@@ -184,6 +199,8 @@ class FabricDryrun:
         self.gossip_interval_ms = gossip_interval_ms
         self.suspect_timeout_ms = suspect_timeout_ms
         self.kill_frac = kill_frac
+        self.transport = transport
+        self.inflight_frames = inflight_frames
         self.ready_timeout_s = ready_timeout_s
         self.settle_timeout_s = settle_timeout_s
         self.log_dir = log_dir
@@ -210,20 +227,24 @@ class FabricDryrun:
         for wid in list(only if only is not None else self.alive):
             self.workers[wid].request(ftype, payload)
 
-    def _send_chunk(self, lines: List[str], count_ack: bool = True) -> str:
+    def _send_chunk(self, lines: List[str], count_ack: bool = True,
+                    replay: bool = False) -> str:
         """Round-robin one chunk at a live worker; a dead target turns
         into detection + takeover + reroute, never a lost chunk.
-        Replayed chunks pass count_ack=False: the victim already acked
-        them once, so the fed==acked ledger counts each chunk once."""
+        Replayed chunks pass count_ack=False (the victim already acked
+        them once, so the fed==acked ledger counts each chunk once) and
+        replay=True (the receiving router skips lines whose pre-death
+        owner is still alive — the duplicate-ban dedupe)."""
         while True:
             if not self.alive:
                 raise RuntimeError("no live workers left")
             target = self.alive[self._rr % len(self.alive)]
             self._rr += 1
             try:
-                self.workers[target].request(
-                    wire.T_LINES, {"lines": lines, "route": True}
-                )
+                payload = {"lines": lines, "route": True}
+                if replay:
+                    payload["replay"] = True
+                self.workers[target].request(wire.T_LINES, payload)
             except (PeerUnavailable, OSError):
                 self._on_death(target)
                 continue
@@ -247,7 +268,7 @@ class FabricDryrun:
         self._await_takeovers(wid)
         replayed = 0
         for chunk in self._journal[wid]:
-            self._send_chunk(chunk, count_ack=False)
+            self._send_chunk(chunk, count_ack=False, replay=True)
             replayed += len(chunk)
         self._journal[wid] = []
         post = {w: self._stats(w) for w in self.alive}
@@ -381,6 +402,13 @@ class FabricDryrun:
             "vnodes": 64,
             "send_timeout_ms": 2000.0,
             "grace_ms": 200.0,
+            # worker-to-worker data path ("json" = inflight 0, the
+            # synchronous PR 11 oracle)
+            "inflight_frames": (
+                0 if self.transport == "json" else self.inflight_frames
+            ),
+            "wire_v2": self.transport != "json",
+            "shm": self.transport == "shm",
         }
         if self.churn:
             payload.update({
@@ -604,7 +632,7 @@ class FabricDryrun:
         # the driver's own direct-feed journal for the victim
         replayed = 0
         for chunk in self._journal[victim]:
-            self._send_chunk(chunk, count_ack=False)
+            self._send_chunk(chunk, count_ack=False, replay=True)
             replayed += len(chunk)
         self._journal[victim] = []
         post = {w: self._stats(w) for w in self.alive}
@@ -639,14 +667,18 @@ class FabricDryrun:
             os.path.join(self.log_dir, f"{nid}.err")
             if self.log_dir else None
         )
+        extra = [
+            "--join", f"127.0.0.1:{seed_worker.port}",
+            "--gossip-interval-ms", str(self.gossip_interval_ms),
+            "--suspect-timeout-ms", str(self.suspect_timeout_ms),
+            "--grace-ms", "200.0",
+        ]
+        if self.transport == "json":
+            extra += ["--inflight-frames", "0", "--wire-v2", "0"]
+        elif self.transport == "shm":
+            extra += ["--shm", "1"]
         newcomer = _spawn(
-            nid, self.broker.port, err_path,
-            extra_args=(
-                "--join", f"127.0.0.1:{seed_worker.port}",
-                "--gossip-interval-ms", str(self.gossip_interval_ms),
-                "--suspect-timeout-ms", str(self.suspect_timeout_ms),
-                "--grace-ms", "200.0",
-            ),
+            nid, self.broker.port, err_path, extra_args=tuple(extra),
         )
         self.workers[nid] = newcomer
         newcomer.read_ready(self.ready_timeout_s)
@@ -878,10 +910,11 @@ class FabricDryrun:
             # fabric ledger: every line that ENTERED this worker
             # (received over the wire, or re-materialized from its
             # journal at takeover) left as exactly one of
-            # local/forwarded/shed
+            # local/forwarded/shed/replay-skipped
             invariants[f"{w}_fabric_ledger"] = (
                 fab["FabricLocalLines"] + fab["FabricForwardedLines"]
                 + fab["FabricShedLines"]
+                + fab.get("FabricReplaySkippedLines", 0)
                 == fab["FabricReceivedLines"] + fab["FabricReplayedLines"]
             )
         invariants["driver_fed_equals_acked"] = (
@@ -907,6 +940,7 @@ class FabricDryrun:
         return {
             "harness": "dryrun_fabric",
             "n_workers": self.n_workers,
+            "transport": self.transport,
             "shape": self.shape,
             "seed": self.seed,
             "scale": self.scale,
@@ -917,6 +951,9 @@ class FabricDryrun:
             "feed_s": round(feed_s, 3),
             "lines_per_sec": round(n_lines / feed_s, 1),
             "engine_bans": len(engine_bans),
+            # canonical ban log: the transport-differential suites
+            # compare this byte-for-byte between wire encodings
+            "ban_log": sorted(f"{ip} {rule}" for ip, rule in engine_bans),
             "oracle_bans": len(oracle_bans),
             "true_positives": tp,
             "precision": round(precision, 6),
@@ -931,3 +968,119 @@ class FabricDryrun:
 def run_fabric(**kwargs) -> dict:
     """Convenience wrapper: one episode, report dict back."""
     return FabricDryrun(**kwargs).run()
+
+
+def run_forward_path(
+    transport: str = "v2",
+    n_chunks: int = 200,
+    chunk_lines: int = 64,
+    inflight_frames: int = 8,
+    ready_timeout_s: float = 420.0,
+    log_dir: Optional[str] = None,
+) -> dict:
+    """Transport micro-benchmark: two shards, w0 fed chunks whose lines
+    are ALL owned by w1, so every line crosses the peer data path
+    ("json" sync / "v2" pipelined TCP / "shm" rings).  The measured
+    window covers feed AND drain (T_FLUSH lands every in-flight frame),
+    so pipelining cannot hide undelivered lines; the audit is
+    transport-lossless delivery (w1 received == w0 forwarded == fed).
+    The destination pipeline buffer (131072 lines) is sized above the
+    row, so acks measure the wire, not the matcher."""
+    from banjax_tpu.fabric.hashring import ConsistentHashRing
+    from banjax_tpu.scenarios.shapes import T0
+
+    ring = ConsistentHashRing(("w0", "w1"), vnodes=64)
+    ips: List[str] = []
+    i = 0
+    while len(ips) < 64:
+        ip = f"10.{(i >> 8) & 255}.{i & 255}.7"
+        if ring.owner(ip) == "w1":
+            ips.append(ip)
+        i += 1
+    chunks = [
+        [
+            f"{T0 + c * 0.001:.6f} "
+            f"{ips[(c * chunk_lines + j) % len(ips)]} "
+            "GET fwd.example GET /about HTTP/1.1 fp -"
+            for j in range(chunk_lines)
+        ]
+        for c in range(n_chunks)
+    ]
+    n_lines = n_chunks * chunk_lines
+
+    workers: Dict[str, _Worker] = {}
+    try:
+        for wid in ("w0", "w1"):
+            err = (
+                os.path.join(log_dir, f"fwd_{wid}.err")
+                if log_dir else None
+            )
+            workers[wid] = _spawn(wid, 0, err)
+        threads = [
+            threading.Thread(
+                target=w.read_ready, args=(ready_timeout_s,), daemon=True
+            )
+            for w in workers.values()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(ready_timeout_s + 5)
+        bad = [
+            f"{w.wid}: {w.ready_error}"
+            for w in workers.values() if w.port is None
+        ]
+        if bad:
+            raise RuntimeError(f"forward-path workers failed: {bad}")
+        hello = {
+            "peers": {
+                w.wid: ["127.0.0.1", w.port] for w in workers.values()
+            },
+            "vnodes": 64,
+            "send_timeout_ms": 2000.0,
+            "grace_ms": 200.0,
+            "inflight_frames": (
+                0 if transport == "json" else inflight_frames
+            ),
+            "wire_v2": transport != "json",
+            "shm": transport == "shm",
+        }
+        for w in workers.values():
+            w.request(wire.T_HELLO, hello)
+
+        t0 = time.perf_counter()
+        for chunk in chunks:
+            workers["w0"].request(
+                wire.T_LINES, {"lines": chunk, "route": True}
+            )
+        drained = workers["w0"].request(wire.T_FLUSH, {"timeout": 600})
+        elapsed = max(1e-9, time.perf_counter() - t0)
+
+        s0 = workers["w0"].request(wire.T_STATS, {})
+        s1 = workers["w1"].request(wire.T_STATS, {})
+        received = int(s1["fabric"]["FabricReceivedLines"])
+        forwarded = int(s0["fabric"]["FabricForwardedLines"])
+        peer_desc = (
+            (s0.get("router") or {}).get("peers") or {}
+        ).get("w1", {})
+        return {
+            "harness": "forward_path",
+            "transport": transport,
+            "peer_transport": peer_desc.get("transport"),
+            "n_lines": n_lines,
+            "chunk_lines": chunk_lines,
+            "feed_s": round(elapsed, 3),
+            "lines_per_sec": round(n_lines / elapsed, 1),
+            "forwarded": forwarded,
+            "received": received,
+            "frames_sent": int(s0["fabric"].get("FabricFramesSent", 0)),
+            "invariants": {
+                "drained": bool(drained.get("flushed")),
+                "all_lines_crossed": (
+                    received == n_lines and forwarded == n_lines
+                ),
+            },
+        }
+    finally:
+        for w in workers.values():
+            w.shutdown()

@@ -20,12 +20,25 @@ router:
   4. replays the dead peer's entire journal through normal routing, so
      the successor re-derives every window state the dead shard held.
 
-Replayed lines are counted (`FabricReplayedLines`), re-journaled
-against their new owners (cascading failures still replay), and may
-double-process lines a survivor already saw — that can only ADD bans
-(a precision cost the harness reports), never lose one: recall vs the
-oracle stays 1.0.  Lines with no alive owner are counted shed, never
-silently dropped.
+Replayed lines are counted (`FabricReplayedLines`) and re-journaled
+against their new owners (cascading failures still replay).  A replay
+is also the one place double-processing used to leak in: a replayed
+chunk can contain lines whose owner never died (the driver replays
+whole direct-feed chunks).  Re-routing those would double-count their
+rate-limit hits on a live shard and mint a duplicate ban (the banked
+n2 precision 0.969697 bug) — so replay recomputes ownership under the
+pre-death view (alive ∪ crashed) and SKIPS lines whose pre-death owner
+is still alive (`FabricReplaySkippedLines`): they were delivered once
+on the normal path and their window state never died.  Lines with no
+alive owner are counted shed, never silently dropped.
+
+When a pipe factory is installed (wire v2), forwards ride per-peer
+pipelined windows (`fabric/peer.py` LinePipe): the group is journaled
+at submit, `route()` returns to matching while frames are in flight,
+and acks stream back on the pipe's I/O thread (which must never take
+the router lock — gossip piggybacks are queued and merged by poll()).
+Without a factory the synchronous per-group JSON path is preserved
+verbatim as the negotiated fallback and differential oracle.
 
 Dynamic membership adds two transitions the static fabric never
 needed: `add_node` (a gossip-discovered joiner — the ring is rebuilt
@@ -44,11 +57,18 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
 from banjax_tpu.fabric.hashring import ConsistentHashRing
-from banjax_tpu.fabric.peer import PeerClient, PeerUnavailable
+from banjax_tpu.fabric.peer import LinePipe, PeerClient, PeerUnavailable
 from banjax_tpu.fabric.stats import FabricStats
 from banjax_tpu.fabric import wire
 from banjax_tpu.resilience import failpoints
 from banjax_tpu.resilience.health import HealthRegistry
+
+# pipe_factory(peer_id, host, port, on_ack) -> LinePipe: installed by
+# service/worker wiring when the pipelined data path is configured
+# (fabric_inflight_frames > 0); absent => the synchronous per-group
+# JSON path below, byte-for-byte the PR 11 behavior (the differential
+# oracle for the transport rewrite)
+PipeFactory = Callable[[str, str, int, Callable], LinePipe]
 
 
 def ip_of_line(line: str) -> str:
@@ -70,6 +90,7 @@ class FabricRouter:
         journal_chunks: int = 4096,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        pipe_factory: Optional[PipeFactory] = None,
     ):
         self.node_id = node_id
         self.ring = ring
@@ -83,6 +104,15 @@ class FabricRouter:
         self._sleep = sleep
         self._lock = threading.RLock()
         self.alive = set(ring.node_ids)
+        # peers that crashed (mark_dead) and have not come back: the
+        # pre-death ownership view for replay dedupe is alive ∪ crashed
+        self._crashed: set = set()
+        self._pipe_factory = pipe_factory
+        self._pipes: Dict[str, LinePipe] = {}
+        # gossip digests from pipelined acks, drained by poll() — the
+        # pipe's I/O thread must never take the router lock (it could
+        # be the thread route() is waiting on for window space)
+        self._gossip_inbox: deque = deque(maxlen=256)
         # graceful-membership hook: a merge callable installed by
         # SwimMembership so digests piggybacked on T_LINES acks feed
         # the membership table (convergence rides the data path)
@@ -102,10 +132,13 @@ class FabricRouter:
 
     def route(self, lines: Sequence[str], replay: bool = False) -> Dict[str, int]:
         """Deliver every line to its owner.  Returns the disposition
-        ledger {local, forwarded, shed} — their sum is always
-        len(lines)."""
+        ledger {local, forwarded, shed, skipped} — their sum is always
+        len(lines).  `skipped` is only ever nonzero on a replay: lines
+        whose pre-death owner is still alive were already processed
+        once, and replaying them would double-count rate-limit hits
+        (the n2 duplicate-ban bug)."""
         self.poll()  # complete any takeover whose grace deadline passed
-        out = {"local": 0, "forwarded": 0, "shed": 0}
+        out = {"local": 0, "forwarded": 0, "shed": 0, "skipped": 0}
         with self._lock:
             self._route_locked(list(lines), out, replay)
         return out
@@ -119,6 +152,10 @@ class FabricRouter:
             self.stats.note_shed(len(lines))
             out["shed"] += len(lines)
             return
+        if replay:
+            lines = self._filter_replay_locked(lines, out)
+            if not lines:
+                return
         by_owner = self.ring.partition(
             [ip_of_line(ln) for ln in lines], self.alive
         )
@@ -129,25 +166,162 @@ class FabricRouter:
                 self.stats.note_local(len(group))
                 out["local"] += len(group)
                 continue
+            pipe = self._pipe_for_locked(owner)
+            if pipe is not None:
+                self._forward_pipelined_locked(owner, pipe, group, out, replay)
+            else:
+                self._forward_sync_locked(owner, group, out, replay)
+
+    def _filter_replay_locked(
+        self, lines: List[str], out: Dict[str, int]
+    ) -> List[str]:
+        """Replay dedupe: recompute ownership under the pre-death view
+        (alive ∪ crashed).  A replayed line whose pre-death owner is
+        still alive was delivered to that owner on the normal path
+        before the crash — it is skipped, not re-processed.  Lines the
+        crashed peers owned are kept: those window states died with
+        their shard and MUST be re-derived (zero-lost-ban)."""
+        if not self._crashed:
+            return lines
+        view = self.alive | self._crashed
+        pre = self.ring.partition([ip_of_line(ln) for ln in lines], view)
+        keep: List[str] = []
+        skipped = 0
+        for owner, idxs in pre.items():
+            if owner in self._crashed:
+                keep.extend(lines[i] for i in idxs)
+            else:
+                skipped += len(idxs)
+        if skipped:
+            self.stats.note_replay_skipped(skipped)
+            out["skipped"] += skipped
+        return keep
+
+    def _forward_pipelined_locked(
+        self, owner: str, pipe: LinePipe, group: List[str],
+        out: Dict[str, int], replay: bool,
+    ) -> None:
+        """Wire v2 data path: journal at submit (the takeover replay
+        source), hand the group to the peer's pipelined window, return
+        to matching — acks stream back on the pipe's I/O thread."""
+        entry = tuple(group)
+        self._journal[owner].append(entry)
+        try:
+            pipe.submit(group, replay=replay)
+        except PeerUnavailable:
+            # the group never entered the window: pull it back out of
+            # the journal (first equal chunk — same multiset) and
+            # reroute it NOW; the takeover replay covers the rest
             try:
-                _rt, rpayload = self.peers[owner].request(
-                    wire.T_LINES, {"lines": group, "replay": replay}
-                )
-            except PeerUnavailable:
-                self.mark_dead(owner, reason="send failed")
-                self._route_locked(group, out, replay)
-                continue
-            self.stats.note_forwarded(len(group))
-            out["forwarded"] += len(group)
-            self._journal[owner].append(tuple(group))
+                self._journal[owner].remove(entry)
+            except ValueError:
+                pass
+            self.mark_dead(owner, reason="pipe dead")
+            self._route_locked(group, out, replay)
+            return
+        self.stats.note_forwarded(len(group))
+        out["forwarded"] += len(group)
+
+    def _forward_sync_locked(
+        self, owner: str, group: List[str],
+        out: Dict[str, int], replay: bool,
+    ) -> None:
+        """The PR 11 synchronous JSON path — kept verbatim as the
+        negotiated fallback and the differential oracle
+        (fabric_inflight_frames = 0)."""
+        try:
+            _rt, rpayload = self.peers[owner].request(
+                wire.T_LINES, {"lines": group, "replay": replay}
+            )
+        except PeerUnavailable:
+            self.mark_dead(owner, reason="send failed")
+            self._route_locked(group, out, replay)
+            return
+        self.stats.note_forwarded(len(group))
+        out["forwarded"] += len(group)
+        self._journal[owner].append(tuple(group))
+        if self.health is not None:
+            comp = self.health.get(f"fabric.peer.{owner}")
+            if comp is not None:
+                comp.beat()
+        if self.gossip_merge is not None:
+            piggy = rpayload.get("gossip")
+            if piggy:
+                self.gossip_merge(piggy)
+
+    # ---- pipelined data path plumbing ----
+
+    def _pipe_for_locked(self, owner: str) -> Optional[LinePipe]:
+        if self._pipe_factory is None:
+            return None
+        pipe = self._pipes.get(owner)
+        if pipe is None:
+            client = self.peers.get(owner)
+            if client is None:
+                return None
+            pipe = self._pipe_factory(
+                owner, client.host, client.port, self._ack_handler(owner)
+            )
+            self._pipes[owner] = pipe
+        return pipe
+
+    def _ack_handler(self, owner: str) -> Callable[[Dict[str, object]], None]:
+        """Runs on the pipe's I/O thread: liveness beat + gossip
+        piggyback capture.  MUST NOT take the router lock (route() may
+        hold it while waiting for this very thread to open window
+        space)."""
+        def _on_ack(payload: Dict[str, object]) -> None:
             if self.health is not None:
                 comp = self.health.get(f"fabric.peer.{owner}")
                 if comp is not None:
                     comp.beat()
-            if self.gossip_merge is not None:
-                piggy = rpayload.get("gossip")
-                if piggy:
-                    self.gossip_merge(piggy)
+            piggy = payload.get("gossip")
+            if piggy:
+                self._gossip_inbox.append(piggy)
+        return _on_ack
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Drain every pipe: all submitted groups sent AND acked.  The
+        routed feed path (`route:true` chunk handlers) and the
+        settle/leave audits call this so an upstream ack means LANDED
+        at the final owner, not parked in a window — the replay dedupe
+        filter's soundness rests on exactly that.  A pipe found dead
+        here triggers its peer's takeover immediately (journal replay
+        through live routing) and the reroutes are drained in the next
+        pass.  True iff fully drained."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self.poll()  # complete any takeover whose deadline passed
+            with self._lock:
+                pipes = dict(self._pipes)
+            ok = True
+            for pipe in pipes.values():
+                if pipe.dead:
+                    continue
+                left = deadline - time.monotonic()
+                ok = pipe.flush(max(0.0, left)) and ok
+            dead = [pid for pid, p in pipes.items() if p.dead]
+            if not dead:
+                return ok
+            for pid in dead:
+                self.mark_dead(pid, reason="pipe dead at flush")
+                with self._lock:
+                    self._drop_pipe_locked(pid)  # even if already !alive
+            if time.monotonic() >= deadline:
+                return False
+
+    def _drop_pipe_locked(self, owner: str) -> None:
+        pipe = self._pipes.pop(owner, None)
+        if pipe is not None:
+            pipe.close()
+
+    def close(self) -> None:
+        """Shut down every pipe (service/worker teardown)."""
+        with self._lock:
+            pipes = list(self._pipes.values())
+            self._pipes.clear()
+        for pipe in pipes:
+            pipe.close()
 
     # ---- membership / takeover ----
 
@@ -167,6 +341,8 @@ class FabricRouter:
                 # the episode is visible in failpoints.snapshot())
                 pass
             self.alive.discard(peer_id)
+            self._crashed.add(peer_id)
+            self._drop_pipe_locked(peer_id)
             self.stats.note_peer(peer_id, False)
             if self.health is not None:
                 comp = self.health.get(f"fabric.peer.{peer_id}")
@@ -190,8 +366,16 @@ class FabricRouter:
 
     def poll(self) -> None:
         """Complete every pending takeover whose grace deadline has
-        passed.  Cheap when nothing is pending; called at route()
-        entry and from the gossip tick."""
+        passed, and merge gossip digests captured from pipelined acks.
+        Cheap when nothing is pending; called at route() entry and
+        from the gossip tick."""
+        if self.gossip_merge is not None:
+            while self._gossip_inbox:
+                try:
+                    piggy = self._gossip_inbox.popleft()
+                except IndexError:
+                    break
+                self.gossip_merge(piggy)
         if not self._pending_takeover:
             return
         now = self._clock()
@@ -216,7 +400,7 @@ class FabricRouter:
             chunks = list(self._journal[peer_id])
             self._journal[peer_id].clear()
             replayed = 0
-            out = {"local": 0, "forwarded": 0, "shed": 0}
+            out = {"local": 0, "forwarded": 0, "shed": 0, "skipped": 0}
             for chunk in chunks:
                 replayed += len(chunk)
                 self.stats.note_replayed(len(chunk))
@@ -243,6 +427,8 @@ class FabricRouter:
             # a revival during the grace window voids the takeover: the
             # peer is back, its journal is its own again
             self._pending_takeover.pop(peer_id, None)
+            self._crashed.discard(peer_id)
+            self._drop_pipe_locked(peer_id)  # a fresh pipe dials the new addr
             client = self.peers.get(peer_id)
             if client is not None and host is not None and port is not None:
                 client.connect_to(host, port)
@@ -272,6 +458,7 @@ class FabricRouter:
             if peer_id != self.node_id:
                 self.peers[peer_id] = client
             self._journal[peer_id] = deque(maxlen=self._journal_chunks)
+            self._crashed.discard(peer_id)
             self.alive.add(peer_id)
             self.stats.note_peer(peer_id, True)
             if self.health is not None and peer_id != self.node_id:
@@ -286,7 +473,9 @@ class FabricRouter:
         owner (the pure-membership handback)."""
         with self._lock:
             self.alive.discard(peer_id)
+            self._crashed.discard(peer_id)
             self._pending_takeover.pop(peer_id, None)
+            self._drop_pipe_locked(peer_id)
             journal = self._journal.get(peer_id)
             if journal is not None:
                 journal.clear()
@@ -315,6 +504,12 @@ class FabricRouter:
                     "breaker": (
                         self.peers[pid].breaker.state
                         if self.peers.get(pid) is not None else ""
+                    ),
+                    "transport": (
+                        f"{self._pipes[pid].mode}/{self._pipes[pid].transport}"
+                        f"[{self._pipes[pid].inflight()}]"
+                        if pid in self._pipes and not self._pipes[pid].dead
+                        else "sync-json"
                     ),
                 }
                 for pid in self.ring.node_ids

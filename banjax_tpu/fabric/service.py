@@ -34,7 +34,7 @@ from banjax_tpu.fabric import wire
 from banjax_tpu.fabric.hashring import ConsistentHashRing
 from banjax_tpu.fabric.membership import SwimMembership
 from banjax_tpu.fabric.node import FabricNode
-from banjax_tpu.fabric.peer import PeerClient
+from banjax_tpu.fabric.peer import LinePipe, PeerClient
 from banjax_tpu.fabric.replication import (
     DecisionReplicator,
     FabricDeduper,
@@ -85,15 +85,22 @@ class FabricService:
         self.deduper = FabricDeduper(
             self.node_id, apply_command, stats=self.stats
         )
+        self._config = config
         self.router = FabricRouter(
             self.node_id, ring, clients, local_submit,
             stats=self.stats, health=health,
             takeover_grace_ms=config.fabric_takeover_grace_ms,
+            pipe_factory=(
+                self._make_pipe
+                if getattr(config, "fabric_inflight_frames", 0) > 0
+                else None
+            ),
         )
         lhost, lport = _split_addr(config.fabric_listen)
         self.membership: Optional[SwimMembership] = None
         handlers = {
             wire.T_LINES: self._h_lines,
+            wire.T_LINES_V2: self._h_lines_v2,
             wire.T_PING: self._h_ping,
             wire.T_PEER_DOWN: self._h_peer_down,
             wire.T_PEER_UP: self._h_peer_up,
@@ -125,6 +132,21 @@ class FabricService:
             pid, host, port, send_timeout_ms=self._send_timeout_ms
         )
 
+    def _make_pipe(self, pid: str, host: str, port: int, on_ack) -> LinePipe:
+        """Router's pipelined data-path factory (fabric_inflight_frames
+        > 0): one windowed LinePipe per forwarded-to peer."""
+        c = self._config
+        return LinePipe(
+            pid, host, port, node_id=self.node_id,
+            send_timeout_ms=c.fabric_send_timeout_ms,
+            inflight_frames=c.fabric_inflight_frames,
+            frame_max_bytes=c.fabric_frame_max_bytes,
+            wire_v2=c.fabric_wire_v2,
+            shm=c.fabric_shm_enabled,
+            shm_ring_bytes=c.fabric_shm_ring_bytes,
+            stats=self.stats, on_ack=on_ack,
+        )
+
     # ---- lifecycle ----
 
     def start(self) -> "FabricService":
@@ -136,6 +158,8 @@ class FabricService:
     def stop(self) -> None:
         if self.membership is not None:
             self.membership.stop()
+        self.router.flush(2.0)  # land in-flight forwards, best effort
+        self.router.close()
         self.node.stop()
         for client in self.router.peers.values():
             if client is not None:
@@ -171,12 +195,33 @@ class FabricService:
             {"gossip": self.membership.digest()}
             if self.membership is not None else {}
         )
+        if "seq" in payload:
+            # a pipelined JSON-mode sender matches acks FIFO by seq
+            piggy["seq"] = payload["seq"]
         if payload.get("route"):
-            out = self.router.route(lines)
+            out = self.router.route(
+                lines, replay=bool(payload.get("replay"))
+            )
+            if out["forwarded"]:
+                # ack upstream == landed at the final owner (the replay
+                # dedupe filter's soundness rests on this; see worker.py)
+                self.router.flush(15.0)
             return wire.T_ACK, {"n": len(lines), **out, **piggy}
         self._local_submit(lines)
         self.stats.note_local(len(lines))
         return wire.T_ACK, {"n": len(lines), "local": len(lines), **piggy}
+
+    def _h_lines_v2(self, fr):
+        # binary data frame (wire.LinesV2): a peer's pipelined forward —
+        # the sender computed ownership, the lines are ours
+        lines = list(fr.lines)
+        self.stats.note_received(len(lines))
+        self._local_submit(lines)
+        self.stats.note_local(len(lines))
+        ack = {"seq": fr.seq, "n": len(lines), "local": len(lines)}
+        if self.membership is not None:
+            ack["gossip"] = self.membership.digest()
+        return wire.T_ACK, ack
 
     def _h_ping(self, payload: dict):
         return wire.T_PONG, {"node_id": self.node_id}

@@ -5,7 +5,8 @@ one lock, `peek()` returns the registry line keys.  The counters close
 the fabric-wide ledger the single-process invariant cannot see:
 
     fed == acked + shed            (driver/router view, per chunk)
-    received == local + forwarded + shed   (per shard)
+    received + replayed == local + forwarded + shed + replay_skipped
+                                   (per shard disposition ledger)
 
 summed with every shard's `admitted == processed + shed + drain_errors`
 they prove no line entered the fabric and vanished, even across a
@@ -17,7 +18,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Tuple
 
-from banjax_tpu.obs.registry import Histogram
+from banjax_tpu.obs.registry import FRAME_BYTES_BUCKETS, Histogram
 
 
 class FabricStats:
@@ -25,11 +26,12 @@ class FabricStats:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self.forwarded_lines = 0       # sent to a peer and acked
+        self.forwarded_lines = 0       # sent to a peer (journaled at submit)
         self.received_lines = 0        # arrived over the wire from a peer
         self.local_lines = 0           # owned locally, submitted in-process
         self.shed_lines = 0            # no alive owner — counted, never silent
         self.replayed_lines = 0        # journal replay after a takeover
+        self.replay_skipped_lines = 0  # replayed lines a live owner already saw
         self.replicated_decisions = 0  # decisions produced to the command topic
         self.replication_errors = 0    # produce attempts that failed (retried)
         self.duplicate_suppressed = 0  # replicated commands dropped by dedupe
@@ -47,6 +49,40 @@ class FabricStats:
         self.gossip_bytes = 0              # probe frames + piggyback digests
         self.member_state: Dict[str, str] = {}  # peer -> alive/suspect/dead/left
         self.detection_time = Histogram()  # last liveness evidence -> confirmed dead
+        # ---- wire v2 transport (fabric/peer.py LinePipe) ----
+        self.frames_sent: Dict[Tuple[str, str], int] = {}  # (version, transport)
+        self.frame_bytes_total = 0
+        self.frame_bytes = Histogram(FRAME_BYTES_BUCKETS)
+        self.acks_received = 0
+        self.ack_rtt = Histogram()                 # seconds, shared buckets
+        self.inflight: Dict[str, int] = {}         # peer -> frames outstanding
+        self.ring_occupancy: Dict[str, float] = {}  # peer -> fill fraction
+
+    def note_frame_sent(
+        self, version: str, transport: str, nbytes: int
+    ) -> None:
+        with self._lock:
+            key = (version, transport)
+            self.frames_sent[key] = self.frames_sent.get(key, 0) + 1
+            self.frame_bytes_total += nbytes
+        self.frame_bytes.observe(float(nbytes))
+
+    def note_ack(self, rtt_s: float) -> None:
+        with self._lock:
+            self.acks_received += 1
+        self.ack_rtt.observe(rtt_s)
+
+    def note_inflight(self, peer_id: str, n: int) -> None:
+        with self._lock:
+            self.inflight[peer_id] = n
+
+    def note_ring_occupancy(self, peer_id: str, frac: float) -> None:
+        with self._lock:
+            self.ring_occupancy[peer_id] = frac
+
+    def note_replay_skipped(self, n: int) -> None:
+        with self._lock:
+            self.replay_skipped_lines += n
 
     def note_forwarded(self, n: int) -> None:
         with self._lock:
@@ -136,6 +172,14 @@ class FabricStats:
                 "FabricLocalLines": self.local_lines,
                 "FabricShedLines": self.shed_lines,
                 "FabricReplayedLines": self.replayed_lines,
+                "FabricReplaySkippedLines": self.replay_skipped_lines,
+                "FabricFramesSent": sum(self.frames_sent.values()),
+                "FabricFrameBytes": self.frame_bytes_total,
+                "FabricAcksReceived": self.acks_received,
+                "FabricInflightFrames": sum(self.inflight.values()),
+                "FabricRingOccupancy": round(
+                    max(self.ring_occupancy.values(), default=0.0), 4
+                ),
                 "FabricReplicatedDecisions": self.replicated_decisions,
                 "FabricReplicationErrors": self.replication_errors,
                 "FabricDuplicatesSuppressed": self.duplicate_suppressed,
@@ -153,6 +197,14 @@ class FabricStats:
     def peers_snapshot(self) -> Dict[str, bool]:
         with self._lock:
             return dict(self.peer_up)
+
+    def frames_snapshot(self) -> Dict[Tuple[str, str], int]:
+        with self._lock:
+            return dict(self.frames_sent)
+
+    def ring_occupancy_snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self.ring_occupancy)
 
     def member_states_snapshot(self) -> Dict[str, str]:
         with self._lock:

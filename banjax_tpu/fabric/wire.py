@@ -1,25 +1,48 @@
 """Length-prefixed fabric socket frames.
 
-One frame = 4-byte big-endian length, 1-byte type, JSON payload.  The
-length covers the type byte + payload, so a reader can pre-allocate
-and a torn stream fails loudly (oversized or truncated frames raise
-instead of desynchronizing).  Every exchange is a synchronous
-request -> response pair on one connection; the client serializes
-requests under its own lock, which is what makes the LINES -> ACK
-accounting exact (a chunk is acked at most once, and the ack carries
-the receiving shard's admitted count).
+One frame = 4-byte big-endian length, 1-byte type, then the body.  The
+length covers the type byte + body, so a reader can pre-allocate and a
+torn stream fails loudly (oversized or truncated frames raise instead
+of desynchronizing).
+
+Two body encodings share that header:
+
+  * **JSON** (wire v1) — every control/gossip/membership frame, the
+    T_ACK response, and the negotiated fallback for peers that predate
+    the binary data path.  A synchronous request -> response exchange
+    per frame; the ack accounting is exact because the server answers
+    frames in order on one connection.
+  * **binary v2** (`T_LINES_V2`) — the data-path hot frame.  Zero JSON
+    on the hot path: a `u64` journal sequence, a `u8` flags byte
+    (bit 0 = replay), a `u32` line count, a `(count+1)`-entry `u32`
+    offset table and the raw UTF-8 line blob.  `decode_lines_v2`
+    validates the offset table strictly (monotone, zero-based, last
+    entry == blob length) so a corrupt frame raises `FrameError`
+    instead of delivering garbled lines.
+
+`T_VERSION` is the connect-time handshake: a v2 sender probes with
+`{"wire": 2}`; a v2 node answers `T_VERSION_R` with its wire version
+(and whether it accepts shm-ring attaches), while an old node answers
+T_ERR ("unhandled frame type") — the sender then negotiates down to
+per-frame JSON losslessly.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import socket
 import struct
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, List, Sequence, Tuple, Union
 
 MAX_FRAME_BYTES = 32 << 20  # one scenario chunk is ~32 KiB; 32 MiB is sabotage
+MAX_V2_LINES = 1 << 22      # offset-table sanity bound, far above any frame
+
+WIRE_VERSION = 2
 
 _HEADER = struct.Struct("!IB")
+_V2_FIXED = struct.Struct("!QBI")  # seq u64, flags u8, count u32
+_V2_REPLAY = 0x01
 
 # frame types — request/response pairs share a row
 T_HELLO = 1        # -> T_HELLO_R     driver/peer handshake, topology push
@@ -45,32 +68,131 @@ T_JOIN = 20        # -> T_JOIN_R      announce + membership/snapshot pull
 T_JOIN_R = 21
 T_LEAVE = 22       # -> T_ACK         admin: graceful drain, then depart
 T_FAILPOINT = 23   # -> T_ACK         harness: arm/disarm a failpoint
+T_LINES_V2 = 24    # -> T_ACK         binary batched line frame (wire v2)
+T_VERSION = 26     # -> T_VERSION_R   wire-version handshake at connect
+T_VERSION_R = 27
+T_RING_ATTACH = 28  # -> T_ACK        co-located peer: switch to shm rings
 
 
 class FrameError(OSError):
     """Malformed or oversized frame — the connection is unusable."""
 
 
-def send_frame(sock: socket.socket, ftype: int, payload: Dict[str, Any]) -> None:
+@dataclasses.dataclass(frozen=True)
+class LinesV2:
+    """A decoded T_LINES_V2 frame: the journal seq the ack must echo,
+    the replay flag, and the batched lines."""
+
+    seq: int
+    replay: bool
+    lines: Tuple[str, ...]
+
+
+def encode_lines_v2(
+    seq: int, lines: Sequence[str], replay: bool = False
+) -> bytes:
+    """One complete T_LINES_V2 frame (header included), ready for
+    sendall/ring-write.  Many routed groups coalesce into one call —
+    the encoder only sees the flattened line list."""
+    blobs = [ln.encode("utf-8") for ln in lines]
+    offsets: List[int] = [0]
+    for b in blobs:
+        offsets.append(offsets[-1] + len(b))
+    body = b"".join((
+        _V2_FIXED.pack(seq, _V2_REPLAY if replay else 0, len(blobs)),
+        struct.pack(f"!{len(offsets)}I", *offsets),
+        b"".join(blobs),
+    ))
+    if 1 + len(body) > MAX_FRAME_BYTES:
+        raise FrameError(f"frame too large: {len(body)} bytes")
+    return _HEADER.pack(1 + len(body), T_LINES_V2) + body
+
+
+def decode_lines_v2(body: bytes) -> LinesV2:
+    """Strict decode — any torn/truncated/inconsistent frame raises
+    FrameError (the fuzz suite in tests/unit/test_fabric_wire_v2.py
+    drives every branch here)."""
+    if len(body) < _V2_FIXED.size:
+        raise FrameError(f"v2 frame truncated: {len(body)} byte body")
+    seq, flags, count = _V2_FIXED.unpack_from(body, 0)
+    if count > MAX_V2_LINES:
+        raise FrameError(f"v2 frame count {count} exceeds {MAX_V2_LINES}")
+    table_end = _V2_FIXED.size + 4 * (count + 1)
+    if len(body) < table_end:
+        raise FrameError(
+            f"v2 offset table truncated: need {table_end}, have {len(body)}"
+        )
+    offsets = struct.unpack_from(f"!{count + 1}I", body, _V2_FIXED.size)
+    blob = body[table_end:]
+    if offsets[0] != 0:
+        raise FrameError(f"v2 offset table must start at 0, got {offsets[0]}")
+    if offsets[-1] != len(blob):
+        raise FrameError(
+            f"v2 blob length mismatch: table says {offsets[-1]}, "
+            f"blob is {len(blob)} bytes"
+        )
+    prev = 0
+    for off in offsets:
+        if off < prev:
+            raise FrameError("v2 offset table not monotone")
+        prev = off
+    try:
+        lines = tuple(
+            blob[offsets[i]:offsets[i + 1]].decode("utf-8")
+            for i in range(count)
+        )
+    except UnicodeDecodeError as exc:
+        raise FrameError(f"v2 line blob not UTF-8: {exc}") from exc
+    return LinesV2(seq=seq, replay=bool(flags & _V2_REPLAY), lines=lines)
+
+
+def encode_frame(ftype: int, payload: Dict[str, Any]) -> bytes:
+    """One complete JSON frame (header included) — the send_frame body
+    without the socket, for transports that write bytes (shm rings)."""
     body = json.dumps(payload, separators=(",", ":")).encode("utf-8")
     if 1 + len(body) > MAX_FRAME_BYTES:
         raise FrameError(f"frame too large: {len(body)} bytes")
-    sock.sendall(_HEADER.pack(1 + len(body), ftype) + body)
+    return _HEADER.pack(1 + len(body), ftype) + body
+
+
+def decode_body(ftype: int, body: bytes) -> Union[Dict[str, Any], LinesV2]:
+    """Decode a frame body by type: LinesV2 for the binary data frame,
+    a JSON object for everything else."""
+    if ftype == T_LINES_V2:
+        return decode_lines_v2(body)
+    try:
+        payload = json.loads(body.decode("utf-8")) if body else {}
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise FrameError(f"undecodable frame payload: {exc}") from exc
+    if not isinstance(payload, dict):
+        raise FrameError("frame payload must be a JSON object")
+    return payload
+
+
+def send_frame(sock: socket.socket, ftype: int, payload: Dict[str, Any]) -> None:
+    sock.sendall(encode_frame(ftype, payload))
 
 
 def recv_frame(sock: socket.socket) -> Tuple[int, Dict[str, Any]]:
+    """Receive one JSON frame.  A binary T_LINES_V2 arriving here is a
+    protocol violation (the caller negotiated v1) — FrameError."""
+    ftype, payload = recv_frame_any(sock)
+    if not isinstance(payload, dict):
+        raise FrameError(f"unexpected binary frame type {ftype}")
+    return ftype, payload
+
+
+def recv_frame_any(
+    sock: socket.socket,
+) -> Tuple[int, Union[Dict[str, Any], LinesV2]]:
+    """Receive one frame of either encoding (a v2-aware server's read
+    loop)."""
     header = _recv_exact(sock, _HEADER.size)
     length, ftype = _HEADER.unpack(header)
     if length < 1 or length > MAX_FRAME_BYTES:
         raise FrameError(f"bad frame length {length}")
     body = _recv_exact(sock, length - 1, committed=True)
-    try:
-        payload = json.loads(body.decode("utf-8")) if length > 1 else {}
-    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
-        raise FrameError(f"undecodable frame payload: {exc}") from exc
-    if not isinstance(payload, dict):
-        raise FrameError("frame payload must be a JSON object")
-    return ftype, payload
+    return ftype, decode_body(ftype, body)
 
 
 def _recv_exact(

@@ -67,6 +67,16 @@ def main(argv=None) -> int:
     ap.add_argument("--suspect-timeout-ms", type=float, default=1200.0)
     ap.add_argument("--indirect-probes", type=int, default=2)
     ap.add_argument("--graceful-leave-ms", type=float, default=5000.0)
+    ap.add_argument("--inflight-frames", type=int, default=8,
+                    help="pipelined data-path window per peer "
+                         "(0 = synchronous JSON forwards, the PR 11 "
+                         "differential oracle)")
+    ap.add_argument("--frame-max-bytes", type=int, default=1 << 20)
+    ap.add_argument("--wire-v2", type=int, default=1)
+    ap.add_argument("--shm", type=int, default=0,
+                    help="1 = co-located peers exchange frames over "
+                         "shm rings instead of loopback TCP")
+    ap.add_argument("--shm-ring-bytes", type=int, default=1 << 21)
     ap.add_argument("--join", default="",
                     help="host:port of one live member — join its ring "
                          "via gossip announce + snapshot sync instead of "
@@ -78,7 +88,7 @@ def main(argv=None) -> int:
     from banjax_tpu.fabric import membership as swim
     from banjax_tpu.fabric import wire
     from banjax_tpu.fabric.node import FabricNode
-    from banjax_tpu.fabric.peer import PeerClient
+    from banjax_tpu.fabric.peer import LinePipe, PeerClient
     from banjax_tpu.fabric.replication import (
         DecisionReplicator,
         FabricDeduper,
@@ -230,6 +240,33 @@ def main(argv=None) -> int:
             send_timeout_ms=float(timeout_ms or args.send_timeout_ms),
         )
 
+    def _pipe_factory_from(payload):
+        """Build the router's LinePipe factory from transport knobs in
+        the HELLO payload (driver-pushed) falling back to the CLI args
+        (join path).  inflight 0 disables the pipelined data path —
+        forwards stay on the synchronous JSON oracle."""
+        inflight = int(payload.get("inflight_frames", args.inflight_frames))
+        if inflight <= 0:
+            return None
+        v2 = bool(payload.get("wire_v2", args.wire_v2))
+        frame_max = int(payload.get("frame_max_bytes", args.frame_max_bytes))
+        shm = bool(payload.get("shm", args.shm))
+        ring_bytes = int(payload.get("shm_ring_bytes", args.shm_ring_bytes))
+        timeout_ms = float(
+            payload.get("send_timeout_ms", args.send_timeout_ms)
+        )
+
+        def factory(pid, host, port, on_ack):
+            return LinePipe(
+                pid, host, int(port), node_id=node_id,
+                send_timeout_ms=timeout_ms,
+                inflight_frames=inflight,
+                frame_max_bytes=frame_max,
+                wire_v2=v2, shm=shm, shm_ring_bytes=ring_bytes,
+                stats=fstats, on_ack=on_ack,
+            )
+        return factory
+
     def _start_membership(router, seeds, gossip_ms, suspect_ms,
                           indirect, listen_port):
         ms = swim.SwimMembership(
@@ -267,6 +304,7 @@ def main(argv=None) -> int:
             takeover_grace_ms=float(
                 payload.get("grace_ms", args.grace_ms)
             ),
+            pipe_factory=_pipe_factory_from(payload),
         )
         state["router"] = router
         gossip_ms = float(
@@ -292,14 +330,39 @@ def main(argv=None) -> int:
         router = state["router"]
         ms = state["membership"]
         piggy = {"gossip": ms.digest()} if ms is not None else {}
+        if "seq" in payload:
+            # a pipelined JSON-mode sender matches acks FIFO by seq
+            piggy["seq"] = payload["seq"]
         if payload.get("route") and router is not None:
-            out = router.route(lines)
+            out = router.route(
+                lines, replay=bool(payload.get("replay"))
+            )
+            if out["forwarded"]:
+                # our ack upstream must mean LANDED, not in-window: a
+                # SIGKILL here would otherwise take acked-but-unflushed
+                # survivor-owned lines down with us, and the replay
+                # dedupe filter would (rightly) refuse to re-run them
+                router.flush(15.0)
             return wire.T_ACK, {"n": len(lines), **out, **piggy}
         _local_submit(lines)
         fstats.note_local(len(lines))
         return wire.T_ACK, {
             "n": len(lines), "local": len(lines), **piggy
         }
+
+    def h_lines_v2(fr):
+        # binary data frame (wire.LinesV2): a peer's pipelined forward —
+        # ownership was already computed by the sender, so the lines go
+        # straight down the local pipeline
+        lines = list(fr.lines)
+        fstats.note_received(len(lines))
+        _local_submit(lines)
+        fstats.note_local(len(lines))
+        ms = state["membership"]
+        ack = {"seq": fr.seq, "n": len(lines), "local": len(lines)}
+        if ms is not None:
+            ack["gossip"] = ms.digest()
+        return wire.T_ACK, ack
 
     def h_peer_down(payload):
         pid = str(payload.get("peer", ""))
@@ -357,7 +420,12 @@ def main(argv=None) -> int:
         budget_s = float(
             payload.get("timeout", args.graceful_leave_ms / 1000.0)
         )
-        flushed = sched.flush(max(budget_s, 1.0))
+        drained = True
+        if router is not None:
+            # land every in-flight forward before draining the local
+            # pipeline: a departing shard leaves no frame on the wire
+            drained = router.flush(max(budget_s, 1.0))
+        flushed = sched.flush(max(budget_s, 1.0)) and drained
         announced = 0
         if ms is not None:
             digest = ms.begin_leave()
@@ -437,8 +505,11 @@ def main(argv=None) -> int:
         return wire.T_ACK, {"applied": applied}
 
     def h_flush(payload):
-        ok = sched.flush(float(payload.get("timeout", 120)))
-        return wire.T_ACK, {"flushed": bool(ok)}
+        t = float(payload.get("timeout", 120))
+        router = state["router"]
+        routed = router.flush(t) if router is not None else True
+        ok = sched.flush(t)
+        return wire.T_ACK, {"flushed": bool(ok and routed)}
 
     def h_ping(payload):
         return wire.T_PONG, {"node_id": node_id}
@@ -452,6 +523,7 @@ def main(argv=None) -> int:
         handlers={
             wire.T_HELLO: h_hello,
             wire.T_LINES: h_lines,
+            wire.T_LINES_V2: h_lines_v2,
             wire.T_PEER_DOWN: h_peer_down,
             wire.T_PEER_UP: h_peer_up,
             wire.T_GOSSIP_PING: h_gossip_ping,
@@ -504,6 +576,7 @@ def main(argv=None) -> int:
                 ConsistentHashRing(ring_ids, vnodes=args.vnodes),
                 clients, _local_submit, stats=fstats, health=health,
                 takeover_grace_ms=args.grace_ms,
+                pipe_factory=_pipe_factory_from({}),
             )
             state["router"] = router
             ms = _start_membership(
@@ -547,6 +620,9 @@ def main(argv=None) -> int:
         ms = state["membership"]
         if ms is not None:
             ms.stop()
+        router = state["router"]
+        if router is not None:
+            router.close()
         if reader is not None:
             reader.stop()
         sched.stop()

@@ -1,0 +1,323 @@
+"""Loader + wrapper for the SPSC shared-memory frame ring (shmring.c).
+
+Co-located fabric shards exchange wire frames through a pair of these
+rings (one per direction) instead of loopback TCP — the shm twin of
+the warm-tier table in native/shm.py, compiled with the same on-demand
+ctypes pattern.  Without a compiler (or under BANJAX_NO_NATIVE) the
+pure-Python `PyRing` keeps the layout and semantics with a polling
+wait, so the transport negotiation never depends on a toolchain.
+
+A ring moves *bytes*; framing stays wire.py's (4-byte length, 1-byte
+type).  Writes are all-or-nothing per frame, so a reader that sees a
+header is guaranteed the body is already in the ring — mid-frame
+stalls can only come from a wedged/dead peer, and surface as
+FrameError exactly like the TCP path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import struct
+import subprocess
+import sysconfig
+import tempfile
+import threading
+import time
+from multiprocessing import shared_memory
+from typing import Optional, Tuple
+
+from banjax_tpu.fabric import wire
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "shmring.c")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+RING_HEADER = 64
+_MAGIC = 0x42414E4A52494E47  # "BANJRING"
+
+# header field offsets (shmring.c ring_hdr)
+_OFF_MAGIC = 0
+_OFF_SIZE = 8
+_OFF_HEAD = 16
+_OFF_TAIL = 24
+
+_U64 = struct.Struct("<Q")
+
+
+def _so_path() -> str:
+    plat = sysconfig.get_platform().replace("-", "_")
+    cache_dir = os.environ.get(
+        "BANJAX_NATIVE_CACHE",
+        os.path.join(tempfile.gettempdir(), "banjax-native"),
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    src_mtime = int(os.stat(_SRC).st_mtime)
+    return os.path.join(cache_dir, f"shmring_{plat}_{src_mtime}.so")
+
+
+def _compile(so: str) -> bool:
+    for cc in (os.environ.get("CC"), "cc", "gcc", "clang"):
+        if not cc:
+            continue
+        cmd = [cc, "-O3", "-shared", "-fPIC", "-o", so, _SRC]
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if r.returncode == 0:
+            return True
+        log.debug("shmring compile with %s failed: %s", cc, r.stderr[-500:])
+    return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("BANJAX_NO_NATIVE"):
+            return None
+        so = _so_path()
+        if not os.path.exists(so) and not _compile(so):
+            log.info("no C compiler; shm ring falls back to Python polling")
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError as e:
+            log.warning("could not load %s: %s", so, e)
+            return None
+        vp = ctypes.c_void_p
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64 = ctypes.c_int64
+        lib.ring_init.restype = i64
+        lib.ring_init.argtypes = [vp, i64]
+        lib.ring_check.restype = i64
+        lib.ring_check.argtypes = [vp]
+        lib.ring_readable.restype = i64
+        lib.ring_readable.argtypes = [vp]
+        lib.ring_write.restype = i64
+        lib.ring_write.argtypes = [vp, u8p, i64, i64]
+        lib.ring_read.restype = i64
+        lib.ring_read.argtypes = [vp, u8p, i64, i64]
+        _LIB = lib
+    return _LIB
+
+
+class RingTimeout(OSError):
+    """The ring did not make progress within the timeout — wedged or
+    dead peer (the writer-side breaker's fast-fail signal)."""
+
+
+class ShmRing:
+    """One direction of a co-located peer link: a single producer and a
+    single consumer over one shared-memory segment.  `name=None`
+    creates (and later unlinks) the segment; passing a name attaches."""
+
+    def __init__(self, name: Optional[str] = None, capacity: int = 1 << 20):
+        if capacity <= 0 or capacity & (capacity - 1):
+            raise ValueError(f"ring capacity must be a power of two: {capacity}")
+        self._lib = _load()
+        size = RING_HEADER + capacity
+        if name is None:
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            self.owner = True
+            self.capacity = capacity
+            self._map_base()
+            if self._lib is not None:
+                if self._lib.ring_init(self._base_ptr, capacity) != 0:
+                    raise ValueError(f"bad ring capacity {capacity}")
+            else:
+                self._py_init(capacity)
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            self.owner = False
+            # attaching must not register the segment with THIS process's
+            # resource tracker (it would unlink on exit, yanking the ring
+            # out from under the creator) — same dance as native/shm.py
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(self._shm._name, "shared_memory")
+            except Exception:  # noqa: BLE001 — tracker internals shifted
+                pass
+            self._map_base()
+            if self._lib is not None:
+                cap = self._lib.ring_check(self._base_ptr)
+            else:
+                cap = self._py_check()
+            if cap < 0:
+                raise RuntimeError(f"shm segment {name} is not a fabric ring")
+            self.capacity = int(cap)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    def _map_base(self) -> None:
+        tmp = (ctypes.c_char * 1).from_buffer(self._shm.buf)
+        self._base_ptr = ctypes.c_void_p(ctypes.addressof(tmp))
+        del tmp
+
+    # ---- data path (native with Python fallback) ----
+
+    def write(self, data: bytes, timeout_s: float) -> None:
+        """All-or-nothing write; RingTimeout if the frame never fits
+        (a stalled consumer), FrameError if it can never fit."""
+        if len(data) > self.capacity:
+            raise wire.FrameError(
+                f"frame of {len(data)} bytes exceeds ring capacity "
+                f"{self.capacity}"
+            )
+        if self._lib is not None:
+            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+            rc = self._lib.ring_write(
+                self._base_ptr, buf, len(data), int(timeout_s * 1000)
+            )
+        else:
+            rc = self._py_write(data, timeout_s)
+        if rc == -1:
+            raise RingTimeout(
+                f"ring write stalled for {timeout_s:.3f}s "
+                f"({self.readable()}/{self.capacity} bytes unread)"
+            )
+        if rc != 0:
+            raise wire.FrameError(f"ring write failed (rc={rc})")
+
+    def read(self, n: int, timeout_s: float) -> Optional[bytes]:
+        """Exactly n bytes, or None on timeout (nothing consumed)."""
+        if self._lib is not None:
+            buf = (ctypes.c_uint8 * n)()
+            rc = self._lib.ring_read(
+                self._base_ptr, buf, n, int(timeout_s * 1000)
+            )
+            if rc == -1:
+                return None
+            if rc != 0:
+                raise wire.FrameError(f"ring read failed (rc={rc})")
+            return bytes(buf)
+        return self._py_read(n, timeout_s)
+
+    def readable(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.ring_readable(self._base_ptr))
+        head = _U64.unpack_from(self._shm.buf, _OFF_HEAD)[0]
+        tail = _U64.unpack_from(self._shm.buf, _OFF_TAIL)[0]
+        return int(head - tail)
+
+    def occupancy(self) -> float:
+        """Fraction of the ring holding unread bytes (the shm-ring
+        occupancy gauge)."""
+        return min(1.0, self.readable() / float(self.capacity))
+
+    # ---- pure-Python fallback (polling; layout-compatible) ----
+
+    def _py_init(self, capacity: int) -> None:
+        buf = self._shm.buf
+        buf[:RING_HEADER] = b"\x00" * RING_HEADER
+        _U64.pack_into(buf, _OFF_SIZE, capacity)
+        _U64.pack_into(buf, _OFF_MAGIC, _MAGIC)
+
+    def _py_check(self) -> int:
+        if _U64.unpack_from(self._shm.buf, _OFF_MAGIC)[0] != _MAGIC:
+            return -1
+        return _U64.unpack_from(self._shm.buf, _OFF_SIZE)[0]
+
+    def _py_write(self, data: bytes, timeout_s: float) -> int:
+        buf = self._shm.buf
+        n, size = len(data), self.capacity
+        deadline = time.monotonic() + timeout_s
+        head = _U64.unpack_from(buf, _OFF_HEAD)[0]
+        pause = 50e-6
+        while True:
+            tail = _U64.unpack_from(buf, _OFF_TAIL)[0]
+            if size - (head - tail) >= n:
+                break
+            if time.monotonic() >= deadline:
+                return -1
+            time.sleep(pause)
+            pause = min(pause * 2, 1e-3)
+        pos = head & (size - 1)
+        first = min(size - pos, n)
+        buf[RING_HEADER + pos:RING_HEADER + pos + first] = data[:first]
+        if n > first:
+            buf[RING_HEADER:RING_HEADER + n - first] = data[first:]
+        _U64.pack_into(buf, _OFF_HEAD, head + n)
+        return 0
+
+    def _py_read(self, n: int, timeout_s: float) -> Optional[bytes]:
+        buf = self._shm.buf
+        size = self.capacity
+        deadline = time.monotonic() + timeout_s
+        tail = _U64.unpack_from(buf, _OFF_TAIL)[0]
+        pause = 50e-6
+        while True:
+            head = _U64.unpack_from(buf, _OFF_HEAD)[0]
+            if head - tail >= n:
+                break
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(pause)
+            pause = min(pause * 2, 1e-3)
+        pos = tail & (size - 1)
+        first = min(size - pos, n)
+        out = bytes(buf[RING_HEADER + pos:RING_HEADER + pos + first])
+        if n > first:
+            out += bytes(buf[RING_HEADER:RING_HEADER + n - first])
+        _U64.pack_into(buf, _OFF_TAIL, tail + n)
+        return out
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:
+        try:
+            self._base_ptr = None
+            self._shm.close()
+        except (OSError, BufferError):
+            pass
+        if self.owner:
+            try:
+                self._shm.unlink()
+            except OSError:
+                pass
+
+
+_FRAME_HEADER = struct.Struct("!IB")
+
+
+def write_frame(ring: ShmRing, frame: bytes, timeout_s: float) -> None:
+    """One whole wire frame, atomically (all-or-nothing)."""
+    ring.write(frame, timeout_s)
+
+
+def read_frame(
+    ring: ShmRing, idle_timeout_s: float
+) -> Optional[Tuple[int, bytes]]:
+    """(ftype, body) or None if no frame started within the idle
+    timeout.  Because writes are whole-frame atomic, a visible header
+    guarantees the body; a missing body is a corrupt ring."""
+    header = ring.read(_FRAME_HEADER.size, idle_timeout_s)
+    if header is None:
+        return None
+    length, ftype = _FRAME_HEADER.unpack(header)
+    if length < 1 or length > wire.MAX_FRAME_BYTES:
+        raise wire.FrameError(f"bad ring frame length {length}")
+    body = ring.read(length - 1, 2.0) if length > 1 else b""
+    if body is None:
+        raise wire.FrameError(
+            f"ring frame torn: header promised {length - 1} body bytes"
+        )
+    return ftype, body
+
+
+def available() -> bool:
+    """True when the native ring is compiled/loadable (the Python
+    fallback still works either way)."""
+    return _load() is not None

@@ -300,6 +300,20 @@ def render_prometheus(
             ],
             fabric.detection_time,
         )
+        frames = fabric.frames_snapshot()
+        if frames:
+            fam = registry.PROM_FAMILIES["banjax_fabric_frames_total"]
+            for (version, transport), n in sorted(frames.items()):
+                w.sample(fam, n,
+                         {"version": version, "transport": transport})
+        w.histogram(
+            registry.PROM_FAMILIES["banjax_fabric_frame_bytes"],
+            fabric.frame_bytes,
+        )
+        w.histogram(
+            registry.PROM_FAMILIES["banjax_fabric_ack_rtt_seconds"],
+            fabric.ack_rtt,
+        )
 
     # component health: aggregate + one labeled gauge per component
     if health is not None:

@@ -53,6 +53,13 @@ LATENCY_BUCKETS_S = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# fixed size buckets (bytes) for wire-frame histograms: a single small
+# control frame through a maximally coalesced fabric_frame_max_bytes blob
+FRAME_BYTES_BUCKETS = (
+    256.0, 1024.0, 4096.0, 16384.0, 65536.0,
+    262144.0, 1048576.0, 4194304.0,
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class Family:
@@ -353,6 +360,39 @@ FAMILIES: List[Family] = [
     Family(HISTOGRAM, "failure-detection latency: last liveness evidence "
            "for a member -> its death confirmed in this node's view (s)",
            prom="banjax_fabric_membership_detection_seconds"),
+    # ---- fabric wire v2 transport (fabric/peer.py LinePipe) ----
+    Family(COUNTER, "data-path frames sent to peers, by negotiated wire "
+           "version (v2 binary / json fallback) and transport (tcp / shm)",
+           prom="banjax_fabric_frames_total",
+           labels=("version", "transport")),
+    Family(COUNTER, "total data-path frames sent (all versions/transports "
+           "— the 29s-line scalar of banjax_fabric_frames_total)",
+           line_key="FabricFramesSent"),
+    Family(COUNTER, "total data-path frame bytes sent to peers",
+           line_key="FabricFrameBytes"),
+    Family(HISTOGRAM, "size of each data-path frame sent (bytes) — how "
+           "well send-side coalescing packs routed groups",
+           prom="banjax_fabric_frame_bytes"),
+    Family(COUNTER, "data-path acks received from peers (frames retired "
+           "from the sliding window)",
+           line_key="FabricAcksReceived",
+           prom="banjax_fabric_acks_total"),
+    Family(GAUGE, "frames currently in flight across all peer windows "
+           "(bounded by fabric_inflight_frames per peer)",
+           line_key="FabricInflightFrames",
+           prom="banjax_fabric_inflight_frames"),
+    Family(HISTOGRAM, "frame send -> ack round trip (s) through the "
+           "pipelined window",
+           prom="banjax_fabric_ack_rtt_seconds"),
+    Family(GAUGE, "worst unread-byte fraction across this node's shm "
+           "peer rings (0 when no ring transport is attached)",
+           line_key="FabricRingOccupancy",
+           prom="banjax_fabric_ring_occupancy"),
+    Family(COUNTER, "takeover-replay lines skipped because their "
+           "pre-death owner is still alive (already processed once — "
+           "replaying would double-count rate-limit hits)",
+           line_key="FabricReplaySkippedLines",
+           prom="banjax_fabric_replay_skipped_lines_total"),
     # ---- pipeline scheduler ----
     Family(COUNTER, "lines+commands admitted into the pipeline",
            line_key="PipelineAdmittedLines",

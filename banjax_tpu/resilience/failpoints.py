@@ -91,6 +91,8 @@ KNOWN_SITES = (
     "fabric.send",
     "fabric.recv",
     "fabric.takeover",
+    "fabric.frame.corrupt",
+    "fabric.ring.stall",
     "fabric.gossip.ping",
     "fabric.gossip.ack",
     "fabric.membership.update",

@@ -1,0 +1,110 @@
+"""Wire v2 / shm-ring transports vs the PR 11 sync-JSON oracle: the
+same seeded scenario through real worker processes must produce a
+byte-identical ban log and the same fabric ledger no matter which
+encoding moved the lines (ISSUE 18 satellite).
+
+`transport="json"` pins `fabric_inflight_frames=0` + `wire_v2=0` on
+every worker — literally the PR 11 data path — so these runs are a
+true A/B of the transport alone: same ring, same chunk feed, same
+scenario seed.  The kill arms include a SIGKILL mid-flood: takeover +
+replay must converge both encodings to the same decisions (recall 1.0,
+precision 1.0 — the n2 duplicate-ban regression gate rides here too).
+"""
+
+import pytest
+
+from banjax_tpu.fabric.harness import run_fabric
+
+_SEED = 20260807
+_SHAPE = "flash_crowd"
+
+# the fabric counters that must be transport-invariant; frame/byte/ack
+# counters legitimately differ (coalescing is the whole point)
+_LEDGER_KEYS = (
+    "FabricReceivedLines", "FabricLocalLines", "FabricForwardedLines",
+    "FabricShedLines", "FabricReplayedLines", "FabricReplaySkippedLines",
+)
+
+_reports = {}
+
+
+def _run(transport, kill):
+    key = (transport, kill)
+    if key not in _reports:
+        _reports[key] = run_fabric(
+            n_workers=2, shape=_SHAPE, seed=_SEED, scale=0.5,
+            kill=kill, transport=transport,
+        )
+    return _reports[key]
+
+
+def _assert_clean(report):
+    bad = [k for k, ok in report["invariants"].items() if not ok]
+    assert not bad, f"{report['transport']}: {bad}"
+    assert report["fed_lines"] == report["acked_lines"]
+
+
+def _ban_log_bytes(report):
+    return ("\n".join(report["ban_log"]) + "\n").encode()
+
+
+def test_v2_vs_json_ban_log_byte_identical_clean_run():
+    ref = _run("json", kill=False)
+    v2 = _run("v2", kill=False)
+    _assert_clean(ref)
+    _assert_clean(v2)
+    assert ref["oracle_bans"] > 0
+    assert _ban_log_bytes(v2) == _ban_log_bytes(ref)
+
+
+def test_v2_vs_json_ledger_sums_identical_clean_run():
+    """Without churn the routing is fully deterministic, so the whole
+    per-worker fabric ledger — not just its invariant — must match the
+    sync oracle exactly."""
+    ref = _run("json", kill=False)
+    v2 = _run("v2", kill=False)
+    for w, ref_w in ref["per_worker"].items():
+        v2_fab = v2["per_worker"][w]["fabric"]
+        for k in _LEDGER_KEYS:
+            assert v2_fab.get(k, 0) == ref_w["fabric"].get(k, 0), (
+                f"{w}.{k}: v2={v2_fab.get(k, 0)} "
+                f"json={ref_w['fabric'].get(k, 0)}"
+            )
+    # and the v2 run actually used the binary path
+    frames = sum(
+        v2["per_worker"][w]["fabric"].get("FabricFramesSent", 0)
+        for w in v2["per_worker"]
+    )
+    assert frames > 0
+
+
+@pytest.mark.slow
+def test_v2_vs_json_sigkill_mid_flood_converges_identically():
+    """Behind -m slow for tier-1 wall-clock: the n2 duplicate-ban
+    regression is still gated in tier-1 by the fabric soak kill test,
+    the router dedupe unit tests, and the bench precision asserts."""
+    ref = _run("json", kill=True)
+    v2 = _run("v2", kill=True)
+    _assert_clean(ref)
+    _assert_clean(v2)
+    for r in (ref, v2):
+        assert r["recall"] == 1.0, r["transport"]
+        assert r["precision"] == 1.0, r["transport"]
+        assert r["takeover"]["victim"] == r["killed"]
+    assert _ban_log_bytes(v2) == _ban_log_bytes(ref)
+
+
+@pytest.mark.slow
+def test_shm_vs_json_sigkill_mid_flood_converges_identically():
+    """Same A/B with the co-located shm-ring transport carrying the
+    forwards (rings die with the SIGKILLed victim, exactly like its
+    sockets — takeover must not care which transport was attached)."""
+    ref = _run("json", kill=True)
+    shm = run_fabric(
+        n_workers=2, shape=_SHAPE, seed=_SEED, scale=0.5,
+        kill=True, transport="shm",
+    )
+    _assert_clean(ref)
+    _assert_clean(shm)
+    assert shm["recall"] == 1.0 and shm["precision"] == 1.0
+    assert _ban_log_bytes(shm) == _ban_log_bytes(ref)

@@ -5,6 +5,7 @@ proves the SIGKILL story — takeover recall 1.0, fabric-wide accounting,
 rejoin handback without double-processing."""
 
 import threading
+import time
 
 import pytest
 
@@ -324,3 +325,95 @@ def test_client_stop_event_short_circuits_retries():
     )
     with pytest.raises(PeerUnavailable):
         client.request(wire.T_PING, {})
+
+
+# ---------------------------------------------------------------------------
+# wire v2 transport failpoints (ISSUE 18): fabric.frame.corrupt +
+# fabric.ring.stall
+# ---------------------------------------------------------------------------
+
+
+def _sink_node(sink):
+    def h_lines(payload):
+        sink.extend(payload.get("lines", []))
+        ack = {"n": len(payload.get("lines", []))}
+        if "seq" in payload:
+            ack["seq"] = payload["seq"]
+        return wire.T_ACK, ack
+
+    def h_lines_v2(fr):
+        sink.extend(fr.lines)
+        return wire.T_ACK, {"seq": fr.seq, "n": len(fr.lines)}
+
+    return FabricNode("127.0.0.1", 0, handlers={
+        wire.T_LINES: h_lines, wire.T_LINES_V2: h_lines_v2,
+    }).start()
+
+
+@pytest.mark.parametrize("v2", [True, False])
+def test_frame_corrupt_is_loud_then_retransmit_heals(caplog, v2):
+    """fabric.frame.corrupt armed once: the flipped byte must fail
+    decode LOUDLY on the peer (never deliver silently garbled lines),
+    the node drops the connection, and the pipe's reconnect+retransmit
+    lands every line anyway — in both wire encodings."""
+    import logging
+
+    from banjax_tpu.fabric.peer import LinePipe
+
+    sink = []
+    node = _sink_node(sink)
+    pipe = LinePipe("p", "127.0.0.1", node.port, node_id="a",
+                    send_timeout_ms=500, wire_v2=v2)
+    try:
+        pipe.submit(["warmup line"])     # handshake + first clean frame
+        assert pipe.flush(10)
+        failpoints.arm("fabric.frame.corrupt", count=1)
+        groups = [[f"corrupt-run-{g}-{i}" for i in range(4)]
+                  for g in range(5)]
+        with caplog.at_level(logging.ERROR, logger="banjax_tpu.fabric.node"):
+            for g in groups:
+                pipe.submit(g)
+            assert pipe.flush(20)
+        assert failpoints.fired_count("fabric.frame.corrupt") == 1
+        assert not pipe.dead
+        # loud on the receiving side
+        assert any("malformed frame" in r.message for r in caplog.records)
+        # nothing garbled was ever delivered, nothing was lost
+        sent = {ln for g in groups for ln in g} | {"warmup line"}
+        assert sent <= set(sink)
+        assert set(sink) <= sent
+    finally:
+        failpoints.disarm()
+        pipe.close()
+        node.stop()
+
+
+def test_ring_stall_breaker_fast_fails_to_peer_unavailable():
+    """fabric.ring.stall armed unlimited on an shm pipe: every transmit
+    attempt faults at the ring, the retry budget burns down, and the
+    pipe dies into PeerUnavailable — the router's takeover trigger —
+    instead of wedging the routing thread behind a stuck ring."""
+    from banjax_tpu.fabric.peer import LinePipe
+
+    sink = []
+    node = _sink_node(sink)
+    pipe = LinePipe("p", "127.0.0.1", node.port, node_id="a",
+                    send_timeout_ms=200, max_attempts=2, shm=True,
+                    backoff=_recording_backoff([]))
+    try:
+        pipe.submit(["ring warmup"])     # rings attach on a clean send
+        assert pipe.flush(10)
+        assert pipe.transport == "shm"
+        failpoints.arm("fabric.ring.stall")
+        pipe.submit(["stalled"])
+        deadline = time.monotonic() + 15
+        while not pipe.dead and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert pipe.dead
+        assert failpoints.fired_count("fabric.ring.stall") >= 1
+        with pytest.raises(PeerUnavailable):
+            pipe.submit(["after the breaker tripped"])
+    finally:
+        failpoints.disarm()
+        pipe.close()
+        node.stop()

@@ -359,8 +359,105 @@ def test_router_all_peers_dead_sheds_counted_never_silent():
     assert stats.peek()["FabricShedLines"] == 0
     r.alive.clear()  # no alive owner at all (shutdown race shape)
     out = r.route(_lines(8))
-    assert out == {"local": 0, "forwarded": 0, "shed": 8}
+    assert out == {"local": 0, "forwarded": 0, "shed": 8, "skipped": 0}
     assert stats.peek()["FabricShedLines"] == 8
+
+
+def test_router_replay_skips_lines_owned_by_survivors_no_double_ban():
+    """Dedupe regression (n2 kill precision 0.969697 in PERF round 16):
+    the driver's takeover-replay re-sends whole acked chunks, but an
+    acked chunk was *fully routed* — survivor-owned lines in it were
+    already processed by their (still alive) owners.  Replaying those
+    lines double-counts them and can push an IP over a rate threshold
+    twice -> duplicate ban.  Replay must re-route only lines whose
+    pre-death owner actually crashed."""
+    r, local, peers, stats = _router()
+    chunk = _lines(120)
+    by_owner = {}
+    for ln in chunk:
+        by_owner.setdefault(r.ring.owner(ip_of_line(ln)), []).append(ln)
+    assert by_owner.get("w1") and (by_owner.get("w0") or by_owner.get("w2"))
+    r.route(chunk)  # the chunk was acked: every line reached its owner
+    processed_before = len(local) + len(peers["w2"].lines)
+
+    peers["w1"].dead = True
+    # isolate the DRIVER-side replay: in production the driver journal
+    # (whole chunks fed to the victim) and this node's forward journal
+    # (victim-owned lines from chunks fed to THIS node) cover disjoint
+    # lines, so drop the forward journal before declaring death
+    r._journal["w1"].clear()
+    r.mark_dead("w1", reason="SIGKILL")
+    stats_before = stats.peek()
+    out = r.route(chunk, replay=True)  # driver journal replays the chunk
+
+    victim_owned = len(by_owner.get("w1", []))
+    survivor_owned = len(chunk) - victim_owned
+    assert out["skipped"] == survivor_owned
+    assert out["local"] + out["forwarded"] + out["shed"] == victim_owned
+    peek = stats.peek()
+    assert (
+        peek["FabricReplaySkippedLines"]
+        - stats_before["FabricReplaySkippedLines"]
+        == survivor_owned
+    )
+    # survivors saw every survivor-owned line exactly once in total:
+    # only the victim's lines were processed a second time
+    processed_after = len(local) + len(peers["w2"].lines)
+    assert processed_after - processed_before == victim_owned
+    # full ledger with the skip column
+    assert (
+        peek["FabricLocalLines"] + peek["FabricForwardedLines"]
+        + peek["FabricShedLines"] + peek["FabricReplaySkippedLines"]
+        == 2 * len(chunk)
+    )
+
+
+def test_router_replay_keeps_crashed_owned_lines_recall_intact():
+    """The skip filter must never touch recall: every line whose
+    pre-death owner crashed is re-routed to a survivor."""
+    r, local, peers, stats = _router()
+    chunk = _lines(120)
+    victim_lines = [
+        ln for ln in chunk if r.ring.owner(ip_of_line(ln)) == "w1"
+    ]
+    r.route(chunk)
+    peers["w1"].dead = True
+    r._journal["w1"].clear()  # isolate the driver-side replay (above)
+    r.mark_dead("w1", reason="SIGKILL")
+    local_before = set(local)
+    w2_before = set(peers["w2"].lines)
+    r.route(chunk, replay=True)
+    replayed_to = (set(local) - local_before) | (
+        set(peers["w2"].lines) - w2_before
+    )
+    assert replayed_to == set(victim_lines)
+
+
+def test_router_replay_with_no_crashed_peers_is_passthrough():
+    """The dedupe filter keys on the crashed set.  With nobody crashed
+    (all peers healthy, or the victim already rejoined via mark_alive)
+    a replay routes everything — PR 11's legacy replay shape, which
+    graceful-leave and rebalance paths still rely on."""
+    r, local, peers, stats = _router()
+    out = r.route(_lines(60), replay=True)
+    assert out["skipped"] == 0
+    assert out["local"] + out["forwarded"] + out["shed"] == 60
+    # rejoin clears the crashed set again
+    peers["w1"].dead = True
+    r.mark_dead("w1", reason="test")
+    peers["w1"].dead = False
+    r.mark_alive("w1", host="127.0.0.1", port=1)
+    out = r.route(_lines(60), replay=True)
+    assert out["skipped"] == 0
+
+
+def test_router_non_replay_route_never_skips():
+    r, local, peers, stats = _router()
+    r.mark_dead("w1", reason="test")
+    peers["w1"].dead = True
+    out = r.route(_lines(80))  # fresh traffic, not a replay
+    assert out["skipped"] == 0
+    assert out["local"] + out["forwarded"] + out["shed"] == 80
 
 
 def test_router_mark_alive_is_pure_membership_no_replay():
@@ -586,6 +683,10 @@ def test_fabric_stats_peek_keys_are_all_registry_declared():
         "FabricMembershipSuspects", "FabricMembershipConfirmedDead",
         "FabricMembershipRefuted", "FabricMembershipJoined",
         "FabricMembershipLeft", "FabricGossipBytes",
+        # ISSUE 18: wire v2 transport counters
+        "FabricReplaySkippedLines", "FabricFramesSent",
+        "FabricFrameBytes", "FabricAcksReceived",
+        "FabricInflightFrames", "FabricRingOccupancy",
     }
     for key in peek:
         assert registry.is_declared_line_key(key), key
@@ -613,6 +714,14 @@ def test_fabric_prom_families_exist_with_stable_names():
         "banjax_fabric_membership_left_total",
         "banjax_fabric_gossip_bytes_total",
         "banjax_fabric_membership_detection_seconds",
+        # ISSUE 18: wire v2 transport families
+        "banjax_fabric_frames_total",
+        "banjax_fabric_frame_bytes",
+        "banjax_fabric_acks_total",
+        "banjax_fabric_inflight_frames",
+        "banjax_fabric_ack_rtt_seconds",
+        "banjax_fabric_ring_occupancy",
+        "banjax_fabric_replay_skipped_lines_total",
     }
     assert expected <= set(registry.PROM_FAMILIES), (
         expected - set(registry.PROM_FAMILIES)
@@ -671,6 +780,12 @@ def test_fabric_config_keys_schema_stable():
     assert cfg.fabric_suspect_timeout_ms == 3000.0
     assert cfg.fabric_indirect_probes == 2
     assert cfg.fabric_graceful_leave_ms == 5000.0
+    # ISSUE 18: wire v2 transport knobs
+    assert cfg.fabric_inflight_frames == 8
+    assert cfg.fabric_wire_v2 is True
+    assert cfg.fabric_frame_max_bytes == 1 << 20
+    assert cfg.fabric_shm_enabled is False
+    assert cfg.fabric_shm_ring_bytes == 1 << 21
     good = config_from_yaml_text(RULES_YAML + """
 fabric_enabled: true
 fabric_node_id: shard-a
@@ -685,6 +800,10 @@ fabric_gossip_interval_ms: 500
 fabric_suspect_timeout_ms: 1500
 fabric_indirect_probes: 3
 fabric_graceful_leave_ms: 2000
+fabric_inflight_frames: 16
+fabric_wire_v2: false
+fabric_frame_max_bytes: 65536
+fabric_shm_ring_bytes: 1048576
 """)
     assert good.fabric_enabled and good.fabric_node_id == "shard-a"
     assert good.fabric_peers["shard-b"] == "10.0.0.2:4480"
@@ -693,6 +812,10 @@ fabric_graceful_leave_ms: 2000
     assert good.fabric_suspect_timeout_ms == 1500.0
     assert good.fabric_indirect_probes == 3
     assert good.fabric_graceful_leave_ms == 2000.0
+    assert good.fabric_inflight_frames == 16
+    assert good.fabric_wire_v2 is False
+    assert good.fabric_frame_max_bytes == 65536
+    assert good.fabric_shm_ring_bytes == 1048576
     # gossip can be disabled outright (static PR 11 fabric)
     off = config_from_yaml_text(RULES_YAML + "\nfabric_gossip_interval_ms: 0")
     assert off.fabric_gossip_interval_ms == 0.0

@@ -1,0 +1,235 @@
+"""SPSC shared-memory ring (native/shmring.py + shmring.c): the
+co-located-shard transport behind LinePipe's shm mode (ISSUE 18).
+
+Both arms run where possible: the compiled C ring (futex waits) and
+the layout-compatible pure-Python fallback.  The contract under test:
+all-or-nothing frame writes, wraparound correctness, loud FrameError
+on oversize or torn frames, None (not garbage) on timeout.
+"""
+
+import threading
+
+import pytest
+
+from banjax_tpu.fabric import wire
+from banjax_tpu.native import shmring
+from banjax_tpu.native.shmring import (
+    RING_HEADER,
+    RingTimeout,
+    ShmRing,
+    read_frame,
+    write_frame,
+)
+
+
+def _arms():
+    arms = ["py"]
+    if shmring.available():
+        arms.insert(0, "native")
+    return arms
+
+
+@pytest.fixture(params=_arms())
+def arm(request, monkeypatch):
+    if request.param == "py":
+        # force the pure-Python fallback even when the .so compiled
+        monkeypatch.setattr(shmring, "_load", lambda: None)
+    return request.param
+
+
+def test_create_attach_roundtrip(arm):
+    owner = ShmRing(capacity=1 << 14)
+    try:
+        other = ShmRing(name=owner.name, capacity=1 << 14)
+        try:
+            assert not owner.readable()
+            owner.write(b"hello-ring", timeout_s=1.0)
+            assert other.read(10, timeout_s=1.0) == b"hello-ring"
+            # and the other direction through the same buffer
+            other.write(b"back", timeout_s=1.0)
+            assert owner.read(4, timeout_s=1.0) == b"back"
+        finally:
+            other.close()
+    finally:
+        owner.close()
+
+
+def test_wraparound_many_times_preserves_bytes(arm):
+    cap = 1 << 12
+    ring = ShmRing(capacity=cap)
+    try:
+        total = 0
+        for i in range(200):  # ~12x the capacity in traffic
+            blob = bytes([i & 0xFF]) * (100 + (i * 37) % 150)
+            ring.write(blob, timeout_s=1.0)
+            got = ring.read(len(blob), timeout_s=1.0)
+            assert got == blob
+            total += len(blob)
+        assert total > 4 * cap
+        assert ring.readable() == 0 and ring.occupancy() == 0.0
+    finally:
+        ring.close()
+
+
+def test_interleaved_producer_consumer_threads(arm):
+    ring = ShmRing(capacity=1 << 12)
+    frames = [
+        wire.encode_lines_v2(i, [f"l{i}-{j}" for j in range(8)])
+        for i in range(100)
+    ]
+
+    got = []
+
+    def consume():
+        while len(got) < len(frames):
+            out = read_frame(ring, idle_timeout_s=5.0)
+            if out is None:
+                return
+            got.append(out)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    try:
+        for f in frames:
+            write_frame(ring, f, timeout_s=5.0)
+        t.join(timeout=20)
+        assert not t.is_alive()
+        assert len(got) == len(frames)
+        for i, (ftype, body) in enumerate(got):
+            assert ftype == wire.T_LINES_V2
+            fr = wire.decode_lines_v2(body)
+            assert fr.seq == i and len(fr.lines) == 8
+    finally:
+        ring.close()
+
+
+def test_oversize_frame_is_frame_error_not_a_hang(arm):
+    ring = ShmRing(capacity=1 << 12)
+    try:
+        with pytest.raises(wire.FrameError):
+            ring.write(b"x" * (1 << 13), timeout_s=0.2)
+        # a frame helper hits the same wall
+        big = wire.encode_lines_v2(1, ["y" * (1 << 13)])
+        with pytest.raises(wire.FrameError):
+            write_frame(ring, big, timeout_s=0.2)
+    finally:
+        ring.close()
+
+
+def test_full_ring_write_times_out_loudly(arm):
+    ring = ShmRing(capacity=1 << 12)
+    try:
+        fill = b"z" * ((1 << 12) - 1)
+        ring.write(fill, timeout_s=1.0)
+        with pytest.raises(RingTimeout):
+            ring.write(b"overflow", timeout_s=0.05)
+        # drain, then the same write lands
+        assert ring.read(len(fill), timeout_s=1.0) == fill
+        ring.write(b"overflow", timeout_s=1.0)
+        assert ring.read(8, timeout_s=1.0) == b"overflow"
+    finally:
+        ring.close()
+
+
+def test_read_timeout_returns_none(arm):
+    ring = ShmRing(capacity=1 << 12)
+    try:
+        assert ring.read(8, timeout_s=0.05) is None
+        assert read_frame(ring, idle_timeout_s=0.05) is None
+    finally:
+        ring.close()
+
+
+def test_occupancy_tracks_buffered_bytes(arm):
+    ring = ShmRing(capacity=1 << 12)
+    try:
+        assert ring.readable() == 0 and ring.occupancy() == 0.0
+        ring.write(b"a" * 100, timeout_s=1.0)
+        ring.write(b"b" * 50, timeout_s=1.0)
+        assert ring.readable() == 150
+        assert ring.occupancy() == pytest.approx(150 / (1 << 12))
+        ring.read(100, timeout_s=1.0)
+        assert ring.readable() == 50
+    finally:
+        ring.close()
+
+
+def test_torn_frame_header_without_body_is_frame_error(arm):
+    ring = ShmRing(capacity=1 << 12)
+    try:
+        # header promises a 10-byte body that never arrives
+        ring.write(wire._HEADER.pack(11, wire.T_ACK), timeout_s=1.0)
+        with pytest.raises(wire.FrameError, match="torn"):
+            read_frame(ring, idle_timeout_s=0.5)
+    finally:
+        ring.close()
+
+
+def test_bad_frame_length_in_ring_is_frame_error(arm):
+    ring = ShmRing(capacity=1 << 12)
+    try:
+        ring.write(
+            wire._HEADER.pack(wire.MAX_FRAME_BYTES + 1, wire.T_ACK),
+            timeout_s=1.0,
+        )
+        with pytest.raises(wire.FrameError):
+            read_frame(ring, idle_timeout_s=0.5)
+    finally:
+        ring.close()
+
+
+def test_capacity_must_be_power_of_two(arm):
+    with pytest.raises(ValueError):
+        ShmRing(capacity=3000)
+
+
+def test_attach_inherits_capacity_from_segment_header(arm):
+    owner = ShmRing(capacity=1 << 12)
+    try:
+        # the header, not the caller's guess, is authoritative
+        other = ShmRing(name=owner.name, capacity=1 << 13)
+        try:
+            assert other.capacity == 1 << 12
+        finally:
+            other.close()
+    finally:
+        owner.close()
+
+
+def test_attach_to_non_ring_segment_is_loud(arm):
+    from multiprocessing import shared_memory
+
+    seg = shared_memory.SharedMemory(create=True, size=RING_HEADER + 64)
+    try:
+        with pytest.raises(RuntimeError, match="not a fabric ring"):
+            ShmRing(name=seg.name)
+    finally:
+        seg.close()
+        seg.unlink()
+
+
+def test_python_and_native_layouts_interoperate():
+    """The fallback must speak the exact same header layout: bytes
+    written by the native ring are read back by the Python path."""
+    if not shmring.available():
+        pytest.skip("native ring not compiled")
+    native = ShmRing(capacity=1 << 12)
+    try:
+        pyside = ShmRing(name=native.name, capacity=1 << 12)
+        pyside._lib = None  # force the _py_* path on this handle
+        try:
+            native.write(b"native->py", timeout_s=1.0)
+            assert pyside.read(10, timeout_s=1.0) == b"native->py"
+            pyside.write(b"py->native", timeout_s=1.0)
+            assert native.read(10, timeout_s=1.0) == b"py->native"
+        finally:
+            pyside.close()
+    finally:
+        native.close()
+
+
+def test_header_offsets_are_frozen():
+    # layout stability: shmring.c and the Python fallback agree on these
+    assert RING_HEADER == 64
+    assert (shmring._OFF_MAGIC, shmring._OFF_SIZE,
+            shmring._OFF_HEAD, shmring._OFF_TAIL) == (0, 8, 16, 24)
