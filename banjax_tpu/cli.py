@@ -171,18 +171,57 @@ class BanjaxApp:
         else:
             self.dynamic_lists = DynamicDecisionLists()
 
+        # compiled serving fast path (httpapi/fastpath.py): the dynamic
+        # lists mirror every insert/expiry into this table; fastserve
+        # consults it before the chain.  Worker mode needs the shm-backed
+        # native table (workers attach by name); without the native
+        # toolchain workers just serve via the chain — never a Py table
+        # only the primary could see.
+        self.decision_table = None
+        if getattr(config, "serve_fastpath_enabled", True):
+            from banjax_tpu.native import decisiontable
+
+            cap = getattr(config, "serve_decision_table_capacity", 65536)
+            try:
+                if n_http_workers > 0:
+                    if decisiontable.available():
+                        self.decision_table = decisiontable.ShmDecisionTable(
+                            capacity=cap
+                        )
+                else:
+                    self.decision_table = decisiontable.create_decision_table(
+                        capacity=cap
+                    )
+            except Exception:  # noqa: BLE001 — fast path off, chain serves
+                log.exception("decision table unavailable; serving via chain")
+                self.decision_table = None
+            if self.decision_table is not None:
+                self.dynamic_lists.set_mirror(self.decision_table)
+
         # ban log files (banjax.go:124-138)
         self._banning_log_file = open(config.banning_log_file, "a", encoding="utf-8")
         temp_path = config.banning_log_file_temp or f"{config.banning_log_file}.tmp"
         self._banning_log_file_temp = open(temp_path, "a", encoding="utf-8")
 
+        ipset_instance = init_ipset(
+            config.iptables_ban_seconds, config.standalone_testing
+        )
+        # netlink-batched kernel edge (effectors/ipset_netlink.py): bans
+        # coalesce into batched AF_NETLINK sends; the subprocess shim
+        # stays as the in-writer fallback and the admin read path
+        self.ipset_writer = None
+        if ipset_instance is not None and getattr(
+            config, "ipset_netlink_enabled", True
+        ):
+            from banjax_tpu.effectors.ipset_netlink import IpsetBatchWriter
+
+            self.ipset_writer = IpsetBatchWriter(ipset_instance)
         self.banner = Banner(
             decision_lists=self.dynamic_lists,
             ban_log_file=self._banning_log_file,
             ban_log_file_temp=self._banning_log_file_temp,
-            ipset_instance=init_ipset(
-                config.iptables_ban_seconds, config.standalone_testing
-            ),
+            ipset_instance=ipset_instance,
+            netlink_writer=self.ipset_writer,
         )
 
         self._matcher = None
@@ -504,6 +543,7 @@ class BanjaxApp:
                 self.fabric.stats if self.fabric is not None else None
             ),
             challenge_verifier=self.challenge_verifier,
+            decision_table=self.decision_table,
         )
 
     async def _serve(self, install_signal_handlers: bool) -> None:
@@ -591,12 +631,28 @@ class BanjaxApp:
         if hasattr(fc, "unlink"):
             fc.close()
             fc.unlink()
+        # same ordering rule for the serving decision table: the metrics
+        # loop and /metrics scrapes sample it (serve_stats), so it closes
+        # only after metrics.stop(); close() NULL-guards later reads
+        dt = self.decision_table
+        if dt is not None:
+            self.decision_table = None
+            try:
+                dt.close()
+                if hasattr(dt, "unlink"):
+                    dt.unlink()
+            except Exception:  # noqa: BLE001
+                pass
         if self.kafka_reader:
             self.kafka_reader.stop()
         if self.kafka_writer:
             self.kafka_writer.stop()
         if self._matcher is not None:
             self._matcher.close()
+        if self.ipset_writer is not None:
+            # final queue drain happens inside close(); errors there are
+            # counted + logged, never raised
+            self.ipset_writer.close()
         self.dynamic_lists.close()
         for f in (self._banning_log_file, self._banning_log_file_temp,
                   self._gin_log_file, self._server_log_file):

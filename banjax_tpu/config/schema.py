@@ -460,6 +460,25 @@ class Config:
     # challengers cannot exhaust the host.  0 = unbounded (the
     # reference's dict semantics, exactly).
     challenge_failure_state_max: int = 0
+    # --- compiled serving path (httpapi/fastpath.py) ---
+    # consult the native shm decision table before the Python decision
+    # chain on /auth_request: a table hit serializes the response from
+    # byte templates (differential-tested byte-identical); any miss or
+    # table fault falls open to the unchanged chain.  false = every
+    # request takes the chain (the reference layout).
+    serve_fastpath_enabled: bool = True
+    # decision-table slots (native/decisiontable.c); rounded up to a
+    # power of two.  A full table refuses inserts (counted in
+    # banjax_serve_fastpath_table_dropped_total) — refused IPs simply
+    # stay chain-served; live decisions are never evicted.
+    serve_decision_table_capacity: int = 65536
+    # --- kernel-edge ban batching (effectors/ipset_netlink.py) ---
+    # coalesce ipset adds into batched AF_NETLINK sends from a bounded
+    # background queue, with the per-entry `ipset` subprocess shim as
+    # fallback (netlink failure, non-IPv4 entries, open breaker) and as
+    # the admin read path.  false = one subprocess fork per ban (the
+    # reference layout).  No effect in standalone testing (no kernel).
+    ipset_netlink_enabled: bool = True
 
 
 # yaml key -> required type; mirrors Go yaml.v2 strictness — a wrong-typed
@@ -531,6 +550,8 @@ _SCALAR_KEYS = {
     "fabric_shm_ring_bytes": int,
     "challenge_device_verify": bool, "challenge_verify_batch_max": int,
     "challenge_failure_state_max": int,
+    "serve_fastpath_enabled": bool, "serve_decision_table_capacity": int,
+    "ipset_netlink_enabled": bool,
 }
 
 _DICT_OR_LIST_KEYS = {
@@ -833,6 +854,12 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
         raise ValueError(
             "config key challenge_failure_state_max: expected 0 (unbounded) "
             f"or a positive entry count, got {cfg.challenge_failure_state_max}"
+        )
+    if cfg.serve_decision_table_capacity < 1:
+        raise ValueError(
+            "config key serve_decision_table_capacity: expected >= 1 "
+            "(rounded up to a power of two), got "
+            f"{cfg.serve_decision_table_capacity}"
         )
 
     return cfg

@@ -51,6 +51,7 @@ class DynamicDecisionLists:
         self._lock = threading.Lock()
         self._by_ip: Dict[str, ExpiringDecision] = {}
         self._by_session_id: Dict[str, ExpiringDecision] = {}
+        self._mirror = None  # native decision table (set_mirror)
         self._stop = threading.Event()
         if start_sweeper:
             t = threading.Thread(target=self._sweep_loop, name="dynamic-lists-sweeper", daemon=True)
@@ -58,6 +59,55 @@ class DynamicDecisionLists:
 
     def close(self) -> None:
         self._stop.set()
+
+    def set_mirror(self, table) -> None:
+        """Attach the native decision table (native/decisiontable.py):
+        every mutation below is mirrored into it UNDER this list's lock,
+        so the serving fast path and this dict move together.  Only the
+        authoritative instance mirrors (the primary's list; worker
+        replicas attach the shm segment read-only) — a replica mirroring
+        too would double-apply every broadcast insert."""
+        with self._lock:
+            self._mirror = table
+
+    # The mirror is an accelerator, never an authority: any table error
+    # degrades to "the fast path misses and the chain serves it", so
+    # mirror calls swallow everything (counted by the serving stats).
+    def _mirror_put(self, ed: ExpiringDecision) -> None:
+        if self._mirror is None:
+            return
+        try:
+            self._mirror.put(
+                ed.ip_address, int(ed.decision), ed.expires,
+                ed.from_baskerville, ed.domain,
+            )
+        except Exception:  # noqa: BLE001
+            self._note_mirror_error()
+
+    def _mirror_del(self, ip: str) -> None:
+        if self._mirror is None:
+            return
+        try:
+            self._mirror.delete(ip)
+        except Exception:  # noqa: BLE001
+            self._note_mirror_error()
+
+    def _mirror_session(self, delta: int) -> None:
+        if self._mirror is None:
+            return
+        try:
+            self._mirror.session_add(delta)
+        except Exception:  # noqa: BLE001
+            self._note_mirror_error()
+
+    @staticmethod
+    def _note_mirror_error() -> None:
+        try:
+            from banjax_tpu.httpapi.serve_stats import get_stats
+
+            get_stats().note_mirror_error()
+        except Exception:  # noqa: BLE001
+            pass
 
     def update(
         self,
@@ -72,9 +122,11 @@ class DynamicDecisionLists:
             existing = self._by_ip.get(ip)
             if existing is not None and new_decision <= existing.decision:
                 return
-            self._by_ip[ip] = ExpiringDecision(
+            ed = ExpiringDecision(
                 new_decision, expires, ip, from_baskerville, domain
             )
+            self._by_ip[ip] = ed
+            self._mirror_put(ed)
 
     def update_by_session_id(
         self,
@@ -93,6 +145,11 @@ class DynamicDecisionLists:
             self._by_session_id[session_id] = ExpiringDecision(
                 new_decision, expires, ip, from_baskerville, domain
             )
+            if existing is None:
+                # the fast path only needs to KNOW session entries exist
+                # (its session guard defers any cookie-bearing request to
+                # the chain); a count is enough, no session keys in shm
+                self._mirror_session(1)
 
     def check(self, session_id: str, client_ip: str) -> Tuple[Optional[ExpiringDecision], bool]:
         """Session id first, then IP; lazy expiry on read (decision.go:474-500).
@@ -108,6 +165,7 @@ class DynamicDecisionLists:
                 if ed is not None:
                     if now - ed.expires > 0:
                         del self._by_session_id[session_id]
+                        self._mirror_session(-1)
                         provenance.record(
                             provenance.SOURCE_EXPIRY, ed.ip_address,
                             ed.decision, rule="session-lazy",
@@ -118,6 +176,7 @@ class DynamicDecisionLists:
             if ed is not None:
                 if now - ed.expires > 0:
                     del self._by_ip[client_ip]
+                    self._mirror_del(client_ip)
                     provenance.record(
                         provenance.SOURCE_EXPIRY, client_ip, ed.decision,
                         rule="lazy",
@@ -148,11 +207,17 @@ class DynamicDecisionLists:
     def remove_by_ip(self, ip: str) -> None:
         with self._lock:
             self._by_ip.pop(ip, None)
+            self._mirror_del(ip)
 
     def clear(self) -> None:
         with self._lock:
             self._by_ip.clear()
             self._by_session_id.clear()
+            if self._mirror is not None:
+                try:
+                    self._mirror.clear()
+                except Exception:  # noqa: BLE001
+                    self._note_mirror_error()
 
     def metrics(self) -> Tuple[int, int]:
         """(len_expiring_challenges, len_expiring_blocks) — decision.go:548-564."""
@@ -175,6 +240,7 @@ class DynamicDecisionLists:
         with self._lock:
             for ip in [ip for ip, ed in self._by_ip.items() if now - ed.expires > 0]:
                 ed = self._by_ip.pop(ip)
+                self._mirror_del(ip)
                 provenance.record(
                     provenance.SOURCE_EXPIRY, ip, ed.decision, rule="sweep"
                 )

@@ -106,12 +106,21 @@ class Banner(BannerInterface):
         ban_log_file: TextIO,
         ban_log_file_temp: TextIO,
         ipset_instance: Optional[IpsetInstance],
+        netlink_writer=None,
     ):
         self.decision_lists = decision_lists
         self._ban_log = ban_log_file
         self._ban_log_temp = ban_log_file_temp
         self._ipset = ipset_instance
+        # batched kernel-edge writer (effectors/ipset_netlink.py): adds
+        # ride the coalesced netlink queue; the admin-surface reads
+        # (test/list/del) keep the subprocess shim
+        self.netlink_writer = netlink_writer
         self._log_lock = threading.Lock()
+
+    @property
+    def ipset_batching(self) -> bool:
+        return self.netlink_writer is not None and self._ipset is not None
 
     def ban_or_challenge_ip(self, config: Config, ip: str, decision: Decision, domain: str) -> None:
         """iptables.go:273-294."""
@@ -186,8 +195,15 @@ class Banner(BannerInterface):
             target.flush()
 
     def ipset_add(self, config: Config, ip: str) -> None:
-        if self._ipset is not None:
-            self._ipset.add(ip, config.iptables_ban_seconds)
+        if self._ipset is None:
+            return
+        if self.netlink_writer is not None:
+            # never blocks, never raises: overflow sheds (counted) and
+            # netlink failures fall back to the subprocess shim inside
+            # the writer's drain thread
+            self.netlink_writer.enqueue(ip, config.iptables_ban_seconds)
+            return
+        self._ipset.add(ip, config.iptables_ban_seconds)
 
     def ipset_test(self, config: Config, ip: str) -> bool:
         # iptables.go:300-303: `banned, _ := b.IPSetInstance.Test(ip)` —
@@ -217,6 +233,12 @@ def _ban_ip(config: Config, ip: str, banner: BannerInterface) -> None:
         return
     if config.standalone_testing:
         log.info("ban_ip: not calling ipset in testing")
+        return
+    if getattr(banner, "ipset_batching", False):
+        # the batched writer's adds are idempotent (`-exist` semantics on
+        # both the netlink and subprocess paths), so the pre-add Test —
+        # one extra fork per ban — buys nothing; skip straight to enqueue
+        banner.ipset_add(config, ip)
         return
     if banner.ipset_test(config, ip):
         log.info("ban_ip: no double ban %s", ip)

@@ -357,6 +357,11 @@ class FastPathServer:
         self.listen_host = listen_host  # admin-surface auth gate input
         config0 = deps.config_holder.get()
         self.standalone = config0.standalone_testing
+        # compiled /auth_request fast path: decision-table hit → template
+        # bytes, anything else (miss / fault / ineligible) → the chain
+        from banjax_tpu.httpapi.fastpath import AuthFastPath
+
+        self.fastpath = AuthFastPath(deps)
 
     # ------------------------------------------------------------- handle
 
@@ -442,12 +447,23 @@ class FastPathServer:
             resp = Response(status=200, body=b"",
                             content_type="text/plain; charset=utf-8")
         else:
+            fast = self.fastpath.try_serve(req)
+            if fast is not None:
+                raw, status = fast
+                proto.write(raw)
+                self._access_log(req, path, status, start)
+                return
             resp = self._auth_request(req)
         proto.write(serialize_response(
             resp, req.keep_alive, head_only=req.method == "HEAD"
         ))
+        self._access_log(req, path, resp.status, start)
 
-        # --- access log middleware (http_server.go:65-95) ---
+    def _access_log(self, req: _ParsedRequest, path: str, status: int,
+                    start: float) -> None:
+        """Access log middleware (http_server.go:65-95) — shared by the
+        template fast path and the full-chain path so both emit the same
+        gin-shaped line."""
         if self.gin_log is not None:
             latency_us = int((time.monotonic() - start) * 1e6)
             line = {
@@ -457,7 +473,7 @@ class FastPathServer:
                 "ClientReqPath": req.header("x-requested-path"),
                 "Method": req.method,
                 "Path": path,
-                "Status": resp.status,
+                "Status": status,
                 "Latency": latency_us,
             }
             self.gin_log.write(json.dumps(line) + "\n")

@@ -1,0 +1,106 @@
+"""Compiled-serving-path counters for the /metrics surfaces.
+
+A LEAF module in the challenge/stats.py mold: obs/exposition.py and
+obs/metrics.py import it lazily, so a process that never takes the
+/auth_request fast path pays one import and one lock per scrape — and
+the banjax_serve_fastpath_* families declared in obs/registry.py keep
+the schema CI-locked like every other surface.
+
+The fastserve fast path (httpapi/fastpath.py) and the dynamic-list
+mirror (decisions/dynamic_lists.py) publish here; totals are
+process-lifetime counters, the table figures are point-in-time gauges
+sampled from the attached decision table at scrape time.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+# every terminal state of one fast-path consultation (the tier label)
+HIT_TIERS = ("allow", "block", "challenge")
+# why the consultation declined and the chain served instead
+MISS_REASONS = ("disabled", "table", "expired", "ineligible", "password",
+                "global_list", "session_guard", "baskerville")
+
+
+class ServeFastpathStats:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._misses: Dict[str, int] = {}
+        self.faults_total = 0          # failpoint / unexpected lookup error
+        self.mirror_errors_total = 0   # dynamic-list mirror write failures
+        self._table = None             # sampled for the gauges at scrape
+
+    def set_table(self, table) -> None:
+        with self._lock:
+            self._table = table
+
+    def note_hit(self, tier: str, n: int = 1) -> None:
+        with self._lock:
+            self._hits[tier] = self._hits.get(tier, 0) + n
+
+    def note_miss(self, reason: str, n: int = 1) -> None:
+        with self._lock:
+            self._misses[reason] = self._misses.get(reason, 0) + n
+
+    def note_fault(self, n: int = 1) -> None:
+        with self._lock:
+            self.faults_total += n
+
+    def note_mirror_error(self, n: int = 1) -> None:
+        with self._lock:
+            self.mirror_errors_total += n
+
+    def prom_snapshot(self) -> dict:
+        with self._lock:
+            table = self._table
+            hits = dict(self._hits)
+            misses = dict(self._misses)
+            faults = self.faults_total
+            mirror_errors = self.mirror_errors_total
+        entries = dropped = sessions = 0
+        if table is not None:
+            try:
+                entries = len(table)
+                dropped = int(table.dropped)
+                sessions = int(table.session_count())
+            except Exception:  # noqa: BLE001 — a closed table reads as 0
+                pass
+        return {
+            "hits": hits,
+            "hits_total": sum(hits.values()),
+            "misses": misses,
+            "misses_total": sum(misses.values()),
+            "faults_total": faults,
+            "mirror_errors_total": mirror_errors,
+            "table_entries": entries,
+            "table_dropped_total": dropped,
+            "table_session_entries": sessions,
+        }
+
+    def active(self) -> bool:
+        """True once the fast path was consulted (or a table attached) in
+        this process — the render gate, so idle scrapes stay clean."""
+        with self._lock:
+            return bool(
+                self._hits or self._misses or self.faults_total
+                or self.mirror_errors_total or self._table is not None
+            )
+
+    def reset(self) -> None:
+        """Test isolation only."""
+        with self._lock:
+            self._hits.clear()
+            self._misses.clear()
+            self.faults_total = 0
+            self.mirror_errors_total = 0
+            self._table = None
+
+
+_stats = ServeFastpathStats()
+
+
+def get_stats() -> ServeFastpathStats:
+    return _stats

@@ -111,6 +111,9 @@ class ServerDeps:
     # device-batched PoW verifier (challenge/verifier.py DeviceVerifier)
     # — None = pure-CPU reference verification, decisions identical
     challenge_verifier: Optional[object] = None
+    # compiled serving fast path (native/decisiontable.py): the table the
+    # dynamic lists mirror into — None = every request takes the chain
+    decision_table: Optional[object] = None
 
 
 _STANDALONE_KEY = "banjax_standalone_hdrs"

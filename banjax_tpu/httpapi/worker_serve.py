@@ -41,6 +41,7 @@ def main(argv: Optional[list] = None) -> int:
     parser.add_argument("-ctrl-dir", dest="ctrl_dir", required=True)
     parser.add_argument("-index", dest="index", type=int, required=True)
     parser.add_argument("-shm-name", dest="shm_name", required=True)
+    parser.add_argument("-dt-shm-name", dest="dt_shm_name", default="")
     parser.add_argument("-standalone-testing", dest="standalone_testing",
                         action="store_true")
     parser.add_argument("-debug", dest="debug", action="store_true")
@@ -71,6 +72,23 @@ def main(argv: Optional[list] = None) -> int:
     protected_paths = PasswordProtectedPaths(config)
     replica = DynamicDecisionLists()
     failed_challenge_states = ShmFailedChallengeStates(name=args.shm_name)
+
+    # the primary's serving decision table, attached read-only: the
+    # replica never mirrors (the primary's broadcast already wrote every
+    # insert into the shm table — mirroring here would double-apply);
+    # a failed attach only costs this worker the fast path
+    decision_table = None
+    if args.dt_shm_name:
+        try:
+            from banjax_tpu.native.decisiontable import ShmDecisionTable
+
+            decision_table = ShmDecisionTable(name=args.dt_shm_name)
+        except Exception:  # noqa: BLE001
+            log.exception(
+                "worker %d: decision table attach failed; serving via chain",
+                args.index,
+            )
+            decision_table = None
 
     def on_reload() -> None:
         log.info("worker %d: reloading config", args.index)
@@ -117,6 +135,7 @@ def main(argv: Optional[list] = None) -> int:
         # stays on the CPU oracle here; the device-batched path runs in
         # single-process serving, where the primary owns the device
         challenge_verifier=None,
+        decision_table=decision_table,
     )
     primary_sock = os.path.join(args.ctrl_dir, PRIMARY_HTTP_SOCK)
 
@@ -138,6 +157,11 @@ def main(argv: Optional[list] = None) -> int:
         control.stop()
         replica.close()
         failed_challenge_states.close()
+        if decision_table is not None:
+            try:
+                decision_table.close()
+            except Exception:  # noqa: BLE001
+                pass
         for f in (gin_log_file, server_log_file):
             if f is not None:
                 try:

@@ -383,6 +383,10 @@ class PrimarySupervisor:
             "-index", str(index),
             "-shm-name", self._app.failed_challenge_states.name,
         ]
+        dt = getattr(self._app, "decision_table", None)
+        if dt is not None and getattr(dt, "name", None):
+            # workers attach the serving decision table read-only by name
+            cmd += ["-dt-shm-name", dt.name]
         if config.standalone_testing:
             cmd.append("-standalone-testing")
         if config.debug:

@@ -276,6 +276,84 @@ def render_prometheus(
             chal_hist,
         )
 
+    # compiled serving fast path (httpapi/serve_stats.py — a leaf
+    # module): per-tier hits, per-reason misses, table gauges; rendered
+    # only when this process consulted the fast path / attached a table
+    try:
+        from banjax_tpu.httpapi.serve_stats import get_stats as _serve_stats
+
+        serve = _serve_stats()
+        serve_snap = serve.prom_snapshot() if serve.active() else None
+    except Exception:  # noqa: BLE001 — a leaf must not break a scrape
+        serve_snap = None
+    if serve_snap is not None:
+        fam = registry.PROM_FAMILIES["banjax_serve_fastpath_hits_total"]
+        for tier, v in sorted(serve_snap["hits"].items()):
+            w.sample(fam, v, {"tier": tier})
+        fam = registry.PROM_FAMILIES["banjax_serve_fastpath_misses_total"]
+        for reason, v in sorted(serve_snap["misses"].items()):
+            w.sample(fam, v, {"reason": reason})
+        w.sample(
+            registry.PROM_FAMILIES["banjax_serve_fastpath_faults_total"],
+            serve_snap["faults_total"],
+        )
+        w.sample(
+            registry.PROM_FAMILIES["banjax_serve_fastpath_table_entries"],
+            serve_snap["table_entries"],
+        )
+        w.sample(
+            registry.PROM_FAMILIES[
+                "banjax_serve_fastpath_table_dropped_total"
+            ],
+            serve_snap["table_dropped_total"],
+        )
+        w.sample(
+            registry.PROM_FAMILIES[
+                "banjax_serve_fastpath_table_session_entries"
+            ],
+            serve_snap["table_session_entries"],
+        )
+        w.sample(
+            registry.PROM_FAMILIES[
+                "banjax_serve_fastpath_mirror_errors_total"
+            ],
+            serve_snap["mirror_errors_total"],
+        )
+
+    # kernel-edge ban batching (effectors/ipset_stats.py — a leaf
+    # module): batch sends, routed failures, queue pressure
+    try:
+        from banjax_tpu.effectors.ipset_stats import get_stats as _ipset_stats
+
+        ipset = _ipset_stats()
+        ipset_snap = ipset.prom_snapshot() if ipset.active() else None
+    except Exception:  # noqa: BLE001 — a leaf must not break a scrape
+        ipset_snap = None
+    if ipset_snap is not None:
+        w.sample(
+            registry.PROM_FAMILIES["banjax_ipset_batch_sends_total"],
+            ipset_snap["batch_sends_total"],
+        )
+        w.sample(
+            registry.PROM_FAMILIES["banjax_ipset_batch_entries_total"],
+            ipset_snap["batch_entries_total"],
+        )
+        fam = registry.PROM_FAMILIES["banjax_ipset_errors_total"]
+        for path, v in sorted(ipset_snap["errors"].items()):
+            w.sample(fam, v, {"path": path})
+        w.sample(
+            registry.PROM_FAMILIES["banjax_ipset_fallback_total"],
+            ipset_snap["fallback_total"],
+        )
+        w.sample(
+            registry.PROM_FAMILIES["banjax_ipset_queue_shed_total"],
+            ipset_snap["queue_shed_total"],
+        )
+        w.sample(
+            registry.PROM_FAMILIES["banjax_ipset_queue_depth"],
+            ipset_snap["queue_depth"],
+        )
+
     # multi-host fabric: per-peer liveness gauge + takeover duration
     # histogram (banjax_tpu/fabric/stats.py; scalar totals merged above)
     if fabric is not None:

@@ -97,6 +97,36 @@ def write_metrics_line(
         line["ChallengeFailureEvictions"] = chal_snap[
             "failure_evictions_total"
         ]
+    # compiled serving fast path (httpapi/serve_stats.py — a leaf
+    # module): same presence rule — only once the fast path ran here
+    try:
+        from banjax_tpu.httpapi.serve_stats import get_stats as _serve_stats
+
+        serve = _serve_stats()
+        serve_snap = serve.prom_snapshot() if serve.active() else None
+    except Exception:  # noqa: BLE001 — a leaf must not break the line
+        serve_snap = None
+    if serve_snap is not None:
+        line["ServeFastpathHits"] = serve_snap["hits_total"]
+        line["ServeFastpathMisses"] = serve_snap["misses_total"]
+        line["ServeFastpathFaults"] = serve_snap["faults_total"]
+        line["ServeTableEntries"] = serve_snap["table_entries"]
+        line["ServeTableDropped"] = serve_snap["table_dropped_total"]
+        line["ServeMirrorErrors"] = serve_snap["mirror_errors_total"]
+    # kernel-edge ban batching (effectors/ipset_stats.py — a leaf module)
+    try:
+        from banjax_tpu.effectors.ipset_stats import get_stats as _ipset_stats
+
+        ipset = _ipset_stats()
+        ipset_snap = ipset.prom_snapshot() if ipset.active() else None
+    except Exception:  # noqa: BLE001 — a leaf must not break the line
+        ipset_snap = None
+    if ipset_snap is not None:
+        line["IpsetBatchSends"] = ipset_snap["batch_sends_total"]
+        line["IpsetBatchEntries"] = ipset_snap["batch_entries_total"]
+        line["IpsetErrors"] = ipset_snap["errors_total"]
+        line["IpsetFallbacks"] = ipset_snap["fallback_total"]
+        line["IpsetQueueShed"] = ipset_snap["queue_shed_total"]
     # Kafka batches skipped for an undecodable codec (lz4/zstd — VERDICT
     # C17): surfaced only when nonzero so the reference's exact key set is
     # preserved on clean streams

@@ -495,6 +495,56 @@ FAMILIES: List[Family] = [
            "state under challenger pressure — bounded memory, never "
            "silent", line_key="ChallengeFailureEvictions",
            prom="banjax_challenge_failure_evictions_total"),
+    # ---- compiled serving fast path (httpapi/fastpath.py) ----
+    Family(COUNTER, "/auth_request responses served from the decision-"
+           "table byte templates, by decision tier",
+           prom="banjax_serve_fastpath_hits_total", labels=("tier",)),
+    Family(COUNTER, "fast-path consultations that fell through to the "
+           "decision chain, by reason",
+           prom="banjax_serve_fastpath_misses_total", labels=("reason",)),
+    Family(COUNTER, "fast-path hits, all tiers (line-only scalar of the "
+           "labeled prom family)", line_key="ServeFastpathHits"),
+    Family(COUNTER, "fast-path misses, all reasons (line-only scalar of "
+           "the labeled prom family)", line_key="ServeFastpathMisses"),
+    Family(COUNTER, "fast-path lookup faults (armed failpoint, torn "
+           "seqlock read budget, unexpected error) — every one fell "
+           "open to the chain", line_key="ServeFastpathFaults",
+           prom="banjax_serve_fastpath_faults_total"),
+    Family(GAUGE, "live entries in the shared decision table",
+           line_key="ServeTableEntries",
+           prom="banjax_serve_fastpath_table_entries"),
+    Family(COUNTER, "inserts refused by a full decision table (the IP "
+           "stays chain-served; live decisions are never evicted)",
+           line_key="ServeTableDropped",
+           prom="banjax_serve_fastpath_table_dropped_total"),
+    Family(GAUGE, "session-id entries mirrored as a count (cookie-"
+           "bearing requests defer to the chain while nonzero)",
+           prom="banjax_serve_fastpath_table_session_entries"),
+    Family(COUNTER, "dynamic-list -> decision-table mirror write "
+           "failures (the table degrades to misses, never authority)",
+           line_key="ServeMirrorErrors",
+           prom="banjax_serve_fastpath_mirror_errors_total"),
+    # ---- kernel-edge ban batching (effectors/ipset_netlink.py) ----
+    Family(COUNTER, "coalesced netlink sendmsg batches acked clean by "
+           "the kernel", line_key="IpsetBatchSends",
+           prom="banjax_ipset_batch_sends_total"),
+    Family(COUNTER, "ipset entries carried by those batches",
+           line_key="IpsetBatchEntries",
+           prom="banjax_ipset_batch_entries_total"),
+    Family(COUNTER, "kernel-edge ban failures by path (netlink send/"
+           "nack vs subprocess shim) — counted and routed, never "
+           "raised into the ban path",
+           prom="banjax_ipset_errors_total", labels=("path",)),
+    Family(COUNTER, "kernel-edge ban failures, all paths (line-only "
+           "scalar of the labeled prom family)", line_key="IpsetErrors"),
+    Family(COUNTER, "entries re-routed from netlink to the per-entry "
+           "subprocess fallback (lossless)", line_key="IpsetFallbacks",
+           prom="banjax_ipset_fallback_total"),
+    Family(COUNTER, "oldest queued bans shed by a full netlink queue "
+           "(bounded memory, never blocks the ban path)",
+           line_key="IpsetQueueShed", prom="banjax_ipset_queue_shed_total"),
+    Family(GAUGE, "bans waiting in the netlink batch queue",
+           prom="banjax_ipset_queue_depth"),
     # ---- histograms (prom-only) ----
     Family(HISTOGRAM, "device verification batch size (candidate "
            "solutions per sha256 kernel dispatch)",

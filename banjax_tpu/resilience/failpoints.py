@@ -60,6 +60,15 @@ Instrumented sites in this tree (KNOWN_SITES):
                      toward its breaker, and the caller re-verifies on
                      the CPU oracle — accept/reject decisions are
                      byte-identical across the drill
+  serve.fastpath.lookup — compiled /auth_request fast path, before the
+                     decision-table probe (httpapi/fastpath.py): an
+                     injected fault counts as a fast-path fault and the
+                     request falls open to the full decision chain —
+                     responses stay byte-identical under the drill
+  ipset.netlink.send — netlink batch writer, before every coalesced
+                     sendmsg (effectors/ipset_netlink.py): an injected
+                     fault routes the whole batch to the per-entry
+                     subprocess fallback — no ban is lost
 """
 
 from __future__ import annotations
@@ -99,6 +108,8 @@ KNOWN_SITES = (
     "challenge.issue",
     "challenge.verify",
     "challenge.device_verify",
+    "serve.fastpath.lookup",
+    "ipset.netlink.send",
 )
 
 MODES = ("error", "sleep")

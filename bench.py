@@ -122,6 +122,18 @@ precision/recall 1.0 vs the scripted oracle.  Banked into
 BENCH_challenge.json.  Knobs:
 BENCH_CHAL_{COOKIES,ZERO_BITS,BATCH,DISTINCT,OFFENDERS,STATE_MAX,SEED},
 BENCH_CPU=1.
+
+Serve mode: `bench.py --serve` — the compiled /auth_request serving
+path (httpapi/fastpath.py + native/decisiontable.c): (a) an in-process
+decision-stage A/B (userspace nine-step chain vs shm-table template
+path, identical already-decided workload) gated at fast path >= 5x
+chain rps; (b) a byte-identity witness over a mixed allow / block /
+challenge / expiring workload including live expiry-boundary
+crossings, gated at 0 mismatches; (c) the real standalone server
+driven by a concurrent raw-socket keepalive capacity client, chain-only
+vs fast-path config, with rps + p50/p99 + the per-tier hit / per-reason
+miss counters.  Banked into BENCH_serve.json.  Knobs:
+BENCH_SERVE_{SEED,ITERS,WITNESS,NPC,CONC,TABLE_CAP}.
 """
 
 from __future__ import annotations
@@ -2378,6 +2390,480 @@ challenge_failure_state_max: {state_max}
     print(json.dumps({"metric": book["metric"], **book["summary"]}))
 
 
+SERVE_PATH = os.path.join(_DIR, "BENCH_serve.json")
+
+
+def _serve_mode() -> None:
+    """`bench.py --serve`: the compiled /auth_request serving path.
+
+    Three sections banked into BENCH_serve.json:
+
+      decision_stage — the per-request serving cost in process: the
+      userspace nine-step chain (decision_for_nginx + the decision-log
+      serialization + serialize_response, exactly what
+      fastserve._auth_request runs) vs the compiled fast path
+      (AuthFastPath.try_serve: one shm decision-table probe, one
+      session HMAC, a template splice) over the identical
+      already-decided workload.  The ISSUE 19 acceptance gate lives
+      here: fast path >= 5x the chain's requests/sec.
+
+      witness — decision identity over a mixed allow / block /
+      challenge / expiring workload, including live expiry-boundary
+      crossings: every fast-path response must byte-equal the chain's
+      for the same request (minted session cookies and challenge
+      payloads normalized — both sides draw fresh randomness);
+      `mismatches` must be 0.
+
+      http_capacity — the end-to-end number: the REAL standalone server
+      on 127.0.0.1:8081 (BanjaxApp, fastserve layout) driven by a
+      concurrent raw-socket keepalive client over the same workload
+      mix, chain-only config vs fast-path config — rps, per-request
+      p50/p99, and the per-tier hit / per-reason miss counters from
+      httpapi/serve_stats on the fast-path arm.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import asyncio
+    import re
+    import shutil
+    import tempfile
+    import types
+
+    from banjax_tpu.config.holder import _PAGES_DIR
+    from banjax_tpu.config.schema import config_from_yaml_text
+    from banjax_tpu.crypto.session import new_session_cookie
+    from banjax_tpu.decisions.dynamic_lists import DynamicDecisionLists
+    from banjax_tpu.decisions.model import Decision
+    from banjax_tpu.decisions.protected_paths import PasswordProtectedPaths
+    from banjax_tpu.decisions.rate_limit import FailedChallengeRateLimitStates
+    from banjax_tpu.decisions.static_lists import StaticDecisionLists
+    from banjax_tpu.httpapi.decision_chain import (
+        ChainState,
+        DecisionListResult,
+        RequestInfo,
+        decision_for_nginx,
+    )
+    from banjax_tpu.httpapi.fastpath import AuthFastPath
+    from banjax_tpu.httpapi.fastserve import serialize_response
+    from banjax_tpu.httpapi.serve_stats import get_stats
+    from banjax_tpu.native.decisiontable import available, create_decision_table
+    from banjax_tpu.scenarios.runtime import RecordingBanner
+    from banjax_tpu.utils import go_query_escape, go_query_unescape
+
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "20260807"))
+    iters = int(os.environ.get("BENCH_SERVE_ITERS", "20000"))
+    witness_n = int(os.environ.get("BENCH_SERVE_WITNESS", "400"))
+    n_per_conn = int(os.environ.get("BENCH_SERVE_NPC", "300"))
+    conc = int(os.environ.get("BENCH_SERVE_CONC", "16"))
+    table_cap = int(os.environ.get("BENCH_SERVE_TABLE_CAP", "65536"))
+    rng = random.Random(seed)
+    session_secret = "bench-serve-session-secret"
+
+    cfg = config_from_yaml_text(f"""
+config_version: bench-serve-1
+global_decision_lists:
+  allow:
+    - 20.20.20.20
+iptables_ban_seconds: 10
+kafka_brokers: [localhost:9092]
+server_log_file: /tmp/banjax-bench-serve.log
+expiring_decision_ttl_seconds: 300
+too_many_failed_challenges_interval_seconds: 60
+too_many_failed_challenges_threshold: 1000000
+password_cookie_ttl_seconds: 14400
+sha_inv_cookie_ttl_seconds: 14400
+sha_inv_expected_zero_bits: 10
+hmac_secret: bench-serve-hmac
+session_cookie_hmac_secret: {session_secret}
+session_cookie_ttl_seconds: 3600
+disable_kafka: true
+""")
+    cfg.challenger_bytes = (
+        _PAGES_DIR / "sha-inverse-challenge.html").read_bytes()
+
+    dyn = DynamicDecisionLists(start_sweeper=False)
+    table = create_decision_table(capacity=table_cap)
+    dyn.set_mirror(table)
+
+    class _Holder:
+        def get(self):
+            return cfg
+
+    deps = types.SimpleNamespace(
+        config_holder=_Holder(),
+        static_lists=StaticDecisionLists(cfg),
+        dynamic_lists=dyn,
+        protected_paths=PasswordProtectedPaths(cfg),
+        failed_challenge_states=FailedChallengeRateLimitStates(),
+        banner=RecordingBanner(),
+        challenge_verifier=None,
+        decision_table=table,
+    )
+    fp = AuthFastPath(deps)
+    chain_state = ChainState(
+        config=cfg, static_lists=deps.static_lists, dynamic_lists=dyn,
+        protected_paths=deps.protected_paths,
+        failed_challenge_states=deps.failed_challenge_states,
+        banner=deps.banner, challenge_verifier=None,
+    )
+
+    class _Req:
+        __slots__ = ("headers", "method", "keep_alive")
+
+        def __init__(self, headers, method="GET"):
+            self.headers = headers
+            self.method = method
+            self.keep_alive = True
+
+        def header(self, name):
+            return self.headers.get(name, "")
+
+    def chain_serve(req):
+        """What fastserve._auth_request runs for /auth_request: cookie
+        parse, RequestInfo, the nine-step chain, the decision-log
+        serialization, wire serialization."""
+        cookies = {}
+        raw = req.headers.get("cookie", "")
+        if raw:
+            for part in raw.split(";"):
+                name, eq, value = part.strip().partition("=")
+                if not eq:
+                    continue
+                try:
+                    cookies[name] = go_query_unescape(value)
+                except ValueError:
+                    continue
+        info = RequestInfo(
+            client_ip=req.headers.get("x-client-ip", ""),
+            requested_host=req.headers.get("x-requested-host", ""),
+            requested_path=req.headers.get("x-requested-path", ""),
+            client_user_agent=req.headers.get("x-client-user-agent", ""),
+            method=req.method,
+            cookies=cookies,
+        )
+        resp, result = decision_for_nginx(chain_state, info)
+        if result.decision_list_result != DecisionListResult.NO_MENTION:
+            result.to_json()  # the decision-log line fastserve emits
+        return serialize_response(resp, req.keep_alive,
+                                  head_only=req.method == "HEAD")
+
+    def _hdrs(ip, host="bench.example.net", **extra):
+        h = {
+            "x-client-ip": ip, "x-requested-host": host,
+            "x-requested-path": "/", "x-client-user-agent": "mozilla",
+        }
+        h.update(extra)
+        return h
+
+    def _clean_cookie(ip, secret=session_secret, ttl=3600):
+        # base64 cookies can carry '+', which QueryUnescape turns into
+        # a space on the echo path (both layouts share the mangle);
+        # draw until clean so echoed bytes are deterministic
+        while True:
+            c = new_session_cookie(secret, ttl, ip)
+            if "+" not in c and "%" not in c:
+                return c
+
+    # ---- seed the decided population (the mirror fills the table) ----
+    now = time.time()
+    allow_ips = [f"10.1.{k >> 8}.{k & 0xFF}" for k in range(256)]
+    block_ips = [f"10.2.0.{k}" for k in range(64)]
+    for ip in allow_ips:
+        dyn.update(ip, now + 3600, Decision.ALLOW, False, "bench")
+    for ip in block_ips:
+        dyn.update(ip, now + 3600, Decision.NGINX_BLOCK, False, "bench")
+
+    # ---- decision_stage A/B: identical ring through both arms ----
+    ring = []
+    for k in range(512):
+        ip = allow_ips[k % 256] if k % 4 else block_ips[(k // 4) % 64]
+        ring.append(_Req(_hdrs(
+            ip, cookie=f"deflect_session={go_query_escape(_clean_cookie(ip))}"
+        )))
+    for req in ring[:64]:  # warm both arms
+        assert fp.try_serve(req) is not None, "fast path must hit the ring"
+        chain_serve(req)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        chain_serve(ring[i % 512])
+    chain_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(iters):
+        fp.try_serve(ring[i % 512])
+    fast_s = time.perf_counter() - t0
+    decision_row = {
+        "iters": iters,
+        "chain_rps": round(iters / chain_s, 1),
+        "fastpath_rps": round(iters / fast_s, 1),
+        "speedup": round(chain_s / fast_s, 2),
+        "native_table": available(),
+    }
+    print(json.dumps({"section": "decision_stage", **decision_row}),
+          flush=True)
+
+    # ---- witness: byte identity over the mixed workload ----
+    # minted session cookies and challenge payloads are fresh randomness
+    # on BOTH sides; mask exactly those spans before comparing
+    _cpat = re.compile(
+        rb"(deflect_challenge3=)([^;]+)|(X-Deflect-Session: )(\S+)"
+        rb"|(deflect_session=)([^;]+)"
+    )
+
+    def _norm(b):
+        return _cpat.sub(
+            lambda m: (m.group(1) or m.group(3) or m.group(5)) + b"<X>", b)
+
+    mismatches = 0
+    witness_requests = 0
+
+    def _compare(headers, normalize=False, expect_hit=None):
+        nonlocal mismatches, witness_requests
+        witness_requests += 1
+        fast = fp.try_serve(_Req(dict(headers)))   # prod order: fast first,
+        cb = chain_serve(_Req(dict(headers)))      # chain lazy-expires after
+        if fast is None:
+            if expect_hit:
+                mismatches += 1
+            return None
+        a, b = (_norm(fast[0]), _norm(cb)) if normalize else (fast[0], cb)
+        if a != b:
+            mismatches += 1
+        return fast
+
+    tiers = {"allow": 0, "block": 0, "challenge": 0, "expired": 0, "miss": 0}
+    expired_ips = [f"10.5.0.{k}" for k in range(8)]
+    for ip in expired_ips:
+        dyn.update(ip, now - 1.0, Decision.NGINX_BLOCK, False, "bench")
+    chal_n = 0
+    for _ in range(witness_n):
+        p = rng.random()
+        if p < 0.40:
+            ip = rng.choice(allow_ips)
+            _compare(_hdrs(ip, cookie=(
+                f"deflect_session={go_query_escape(_clean_cookie(ip))}"
+            )), expect_hit=True)
+            tiers["allow"] += 1
+        elif p < 0.55:
+            _compare(_hdrs(rng.choice(allow_ips)), normalize=True,
+                     expect_hit=True)  # cookieless: both arms mint
+            tiers["allow"] += 1
+        elif p < 0.72:
+            ip = rng.choice(block_ips)
+            _compare(_hdrs(ip, cookie=(
+                f"deflect_session={go_query_escape(_clean_cookie(ip))}"
+            )), expect_hit=True)
+            tiers["block"] += 1
+        elif p < 0.82:
+            ip = f"10.3.{chal_n >> 8}.{chal_n & 0xFF}"
+            chal_n += 1
+            dyn.update(ip, now + 3600, Decision.CHALLENGE, False, "bench")
+            _compare(_hdrs(ip), normalize=True, expect_hit=True)
+            tiers["challenge"] += 1
+        elif p < 0.92:
+            _compare(_hdrs(rng.choice(expired_ips)), normalize=True)
+            tiers["expired"] += 1
+        else:
+            _compare(_hdrs(
+                f"172.16.{rng.randint(0, 255)}.{rng.randint(1, 254)}"
+            ), normalize=True)
+            tiers["miss"] += 1
+
+    # live expiry-boundary crossing: entries expire mid-sweep; every
+    # sample must agree (hit -> identical bytes, then both flip to the
+    # post-expiry decision)
+    boundary_ips = [f"10.4.0.{k}" for k in range(4)]
+    flip_at = time.time() + 1.0
+    for ip in boundary_ips:
+        dyn.update(ip, flip_at, Decision.ALLOW, False, "bench")
+    boundary_samples = 0
+    boundary_flips = 0
+    was_hit = dict.fromkeys(boundary_ips)
+    while time.time() < flip_at + 0.4:
+        for ip in boundary_ips:
+            fast = _compare(_hdrs(ip), normalize=True)
+            hit = fast is not None
+            if was_hit[ip] and not hit:
+                boundary_flips += 1
+            was_hit[ip] = hit
+            boundary_samples += 1
+        time.sleep(0.03)
+
+    witness_row = {
+        "requests": witness_requests,
+        "mismatches": mismatches,
+        "tiers": tiers,
+        "boundary_samples": boundary_samples,
+        "boundary_flips": boundary_flips,
+        "fastpath_counters": get_stats().prom_snapshot(),
+    }
+    print(json.dumps({"section": "witness", **witness_row}), flush=True)
+
+    get_stats().reset()
+    dyn.close()
+    table.close()
+    if hasattr(table, "unlink"):
+        table.unlink()
+
+    # ---- http_capacity: the real server, chain-only vs fast path ----
+    fixture = os.path.join(_DIR, "tests", "fixtures",
+                           "banjax-config-test.yaml")
+    with open(fixture) as f:
+        base_yaml = f.read()
+
+    def _http_arm(enabled):
+        from banjax_tpu.cli import BanjaxApp
+
+        tmp_dir = tempfile.mkdtemp(prefix="bench-serve-")
+        cwd = os.getcwd()
+        os.chdir(tmp_dir)
+        cfg_path = os.path.join(tmp_dir, "banjax-config.yaml")
+        with open(cfg_path, "w") as f:
+            f.write(base_yaml + "\nserve_fastpath_enabled: "
+                    + ("true" if enabled else "false") + "\n")
+        get_stats().reset()
+        app = BanjaxApp(cfg_path, standalone_testing=True, debug=False)
+        app.start_background()
+        try:
+            now2 = time.time()
+            h_allow = [f"10.11.{k >> 8}.{k & 0xFF}" for k in range(64)]
+            h_block = [f"10.12.0.{k}" for k in range(16)]
+            h_chal = [f"10.13.0.{k}" for k in range(4)]
+            h_expired = [f"10.14.0.{k}" for k in range(8)]
+            for ip in h_allow:
+                app.dynamic_lists.update(ip, now2 + 3600, Decision.ALLOW,
+                                         False, "bench")
+            for ip in h_block:
+                app.dynamic_lists.update(ip, now2 + 3600,
+                                         Decision.NGINX_BLOCK, False, "bench")
+            for ip in h_chal:
+                app.dynamic_lists.update(ip, now2 + 3600, Decision.CHALLENGE,
+                                         False, "bench")
+            for ip in h_expired:
+                app.dynamic_lists.update(ip, now2 - 1.0, Decision.ALLOW,
+                                         False, "bench")
+
+            def _raw(ip, cookie=None):
+                lines = [
+                    "GET /auth_request?path=%2F HTTP/1.1",
+                    "Host: bench.example.net",
+                    f"X-Client-IP: {ip}",
+                ]
+                if cookie is not None:
+                    lines.append(
+                        f"Cookie: deflect_session={go_query_escape(cookie)}")
+                lines.append("Connection: keep-alive")
+                return ("\r\n".join(lines) + "\r\n\r\n").encode()
+
+            arm_rng = random.Random(seed + 1)  # same workload both arms
+            reqs = []
+            for _ in range(1024):
+                p = arm_rng.random()
+                if p < 0.70:
+                    ip = arm_rng.choice(h_allow)
+                    reqs.append(_raw(ip, _clean_cookie(ip, "session_secret")))
+                elif p < 0.78:
+                    reqs.append(_raw(arm_rng.choice(h_allow)))
+                elif p < 0.88:
+                    ip = arm_rng.choice(h_block)
+                    reqs.append(_raw(ip, _clean_cookie(ip, "session_secret")))
+                elif p < 0.92:
+                    reqs.append(_raw(arm_rng.choice(h_expired)))
+                elif p < 0.97:
+                    reqs.append(_raw(
+                        f"172.17.{arm_rng.randint(0, 255)}"
+                        f".{arm_rng.randint(1, 254)}"))
+                else:
+                    reqs.append(_raw(arm_rng.choice(h_chal)))
+
+            async def _worker(items, lats):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", 8081)
+                for raw in items:
+                    t_req = time.perf_counter()
+                    writer.write(raw)
+                    await writer.drain()
+                    hdr = await reader.readuntil(b"\r\n\r\n")
+                    clen = 0
+                    for line in hdr.split(b"\r\n"):
+                        if line.lower().startswith(b"content-length:"):
+                            clen = int(line.split(b":")[1])
+                    if clen:
+                        await reader.readexactly(clen)
+                    lats.append(time.perf_counter() - t_req)
+                writer.close()
+
+            async def _drive(n_each):
+                lats = []
+                t_run = time.perf_counter()
+                await asyncio.gather(*[
+                    _worker([reqs[(w * 131 + i) % 1024]
+                             for i in range(n_each)], lats)
+                    for w in range(conc)
+                ])
+                return lats, time.perf_counter() - t_run
+
+            asyncio.run(_drive(40))  # warm
+            get_stats().reset()
+            if getattr(app, "decision_table", None) is not None:
+                get_stats().set_table(app.decision_table)
+            lats, elapsed = asyncio.run(_drive(n_per_conn))
+            lats.sort()
+            row = {
+                "requests": len(lats),
+                "rps": round(len(lats) / elapsed, 1),
+                "p50_us": round(lats[len(lats) // 2] * 1e6, 1),
+                "p99_us": round(lats[min(len(lats) - 1,
+                                         int(len(lats) * 0.99))] * 1e6, 1),
+                "conc": conc,
+                "n_per_conn": n_per_conn,
+                "fastpath_enabled": enabled,
+            }
+            if enabled:
+                row["fastpath_counters"] = get_stats().prom_snapshot()
+            return row
+        finally:
+            app.stop_background()
+            os.chdir(cwd)
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+
+    row_chain = _http_arm(False)
+    print(json.dumps({"section": "http_chain_only", **row_chain}), flush=True)
+    row_fast = _http_arm(True)
+    print(json.dumps({"section": "http_fastpath", **row_fast}), flush=True)
+
+    book = {
+        "metric": ("compiled /auth_request fast path vs userspace chain "
+                   "(shm decision table + byte templates)"),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "seed": seed,
+        "rows": {
+            "decision_stage": decision_row,
+            "witness": witness_row,
+            "http_capacity": {
+                "chain_only": row_chain,
+                "fastpath": row_fast,
+                "speedup": round(row_fast["rps"] / row_chain["rps"], 3),
+            },
+        },
+        "summary": {
+            "chain_rps": decision_row["chain_rps"],
+            "fastpath_rps": decision_row["fastpath_rps"],
+            "speedup_fastpath_vs_chain": decision_row["speedup"],
+            "witness_requests": witness_requests,
+            "witness_mismatches": mismatches,
+            "http_rps_chain_only": row_chain["rps"],
+            "http_rps_fastpath": row_fast["rps"],
+            "acceptance_speedup_5x": decision_row["speedup"] >= 5.0,
+            "acceptance_witness_clean": mismatches == 0,
+        },
+    }
+    tmp_path = SERVE_PATH + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(book, f, indent=1)
+    os.replace(tmp_path, SERVE_PATH)
+    print(json.dumps({"metric": book["metric"], **book["summary"]}))
+
+
 def _single_kernel_mode() -> None:
     """`bench.py --single-kernel`: the streaming pipeline + device
     windows with the single-kernel fused program ON (one dispatch, one
@@ -2800,6 +3286,9 @@ def main() -> None:
         return
     if "--challenge" in sys.argv:
         _challenge_mode()
+        return
+    if "--serve" in sys.argv:
+        _serve_mode()
         return
     if "--scenarios" in sys.argv:
         _scenarios_mode()

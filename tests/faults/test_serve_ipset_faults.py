@@ -1,0 +1,169 @@
+"""Fail-open drills for the compiled serving path and the kernel edge.
+
+`serve.fastpath.lookup` armed: every /auth_request rides the unchanged
+decision chain, responses stay byte-identical (normalized), and each
+suppressed consultation is a counted fault — never an error surfaced to
+nginx.  `ipset.netlink.send` armed: every coalesced batch routes to the
+per-entry subprocess fallback with zero bans lost, and the netlink path
+resumes the moment the failpoint is disarmed.
+"""
+
+import re
+import socket
+import time
+from pathlib import Path
+
+import pytest
+
+from banjax_tpu.decisions.model import Decision
+from banjax_tpu.effectors import ipset_netlink as nl
+from banjax_tpu.effectors.ipset_stats import get_stats as ipset_stats
+from banjax_tpu.httpapi.serve_stats import get_stats as serve_stats
+from banjax_tpu.resilience import failpoints
+
+_FIXTURES = Path(__file__).resolve().parent.parent / "fixtures"
+HOST = "eligible.example.net"
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    failpoints.disarm()
+    serve_stats().reset()
+    ipset_stats().reset()
+    yield
+    failpoints.disarm()
+    serve_stats().reset()
+    ipset_stats().reset()
+
+
+_MASK = re.compile(rb"(X-Deflect-Session: |deflect_session=)([^;\r\n]+)")
+
+
+def _get(ip):
+    s = socket.create_connection(("127.0.0.1", 8081), timeout=5)
+    try:
+        s.sendall(
+            (f"GET /auth_request?path=/ HTTP/1.1\r\nHost: {HOST}\r\n"
+             f"X-Client-IP: {ip}\r\nConnection: close\r\n\r\n").encode()
+        )
+        out = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            out += chunk
+    finally:
+        s.close()
+    return _MASK.sub(rb"\1MASKED", out)
+
+
+def test_armed_fastpath_lookup_fails_open_byte_identical(
+    app_factory, tmp_path
+):
+    cfg = tmp_path / "cfg-fp-fault.yaml"
+    cfg.write_text(
+        (_FIXTURES / "banjax-config-test.yaml").read_text()
+        + "\nhttp_fast_path: true\nserve_fastpath_enabled: true\n"
+    )
+    app = app_factory(str(cfg))
+    time.sleep(0.5)
+    app.dynamic_lists.update(
+        "44.0.0.1", time.time() + 600, Decision.ALLOW, False, "d"
+    )
+    stats = serve_stats()
+
+    baseline = _get("44.0.0.1")  # fast-path hit
+    assert baseline.startswith(b"HTTP/1.1 200")
+    assert stats.prom_snapshot()["hits"]["allow"] == 1
+
+    failpoints.arm("serve.fastpath.lookup", count=3)
+    for i in range(3):
+        assert _get("44.0.0.1") == baseline, f"armed request {i} diverged"
+    snap = stats.prom_snapshot()
+    assert snap["faults_total"] == 3
+    assert snap["hits_total"] == 1  # no hit while armed
+    assert failpoints.fired_count("serve.fastpath.lookup") == 3
+
+    # the bounded arming is exhausted: the fast path serves again
+    assert _get("44.0.0.1") == baseline
+    assert stats.prom_snapshot()["hits"]["allow"] == 2
+    app.stop_background()
+
+
+class _FakeSock:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, buf):
+        self.sent.append(buf)
+
+    def recv(self, _n):
+        import struct
+
+        n = self.sent[-1].count(
+            struct.pack("=HH", (nl.NFNL_SUBSYS_IPSET << 8) | nl.IPSET_CMD_ADD,
+                        nl.NLM_F_REQUEST | nl.NLM_F_ACK)
+        )
+        return b"".join(
+            struct.pack("=IHHII", 20, nl.NLMSG_ERROR, 0, i + 1, 0)
+            + struct.pack("=i", 0)
+            for i in range(n)
+        )
+
+    def close(self):
+        pass
+
+
+class _FakeIpset:
+    name = "banjax"
+
+    def __init__(self):
+        self.added = []
+
+    def add(self, ip, timeout):
+        self.added.append((ip, timeout))
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while not pred() and time.time() < deadline:
+        time.sleep(0.01)
+    assert pred(), "condition not reached"
+
+
+def test_armed_netlink_send_falls_back_lossless():
+    ipset = _FakeIpset()
+    sock = _FakeSock()
+    w = nl.IpsetBatchWriter(ipset, flush_interval=0.01)
+    w._socket = lambda: sock
+    try:
+        failpoints.arm("ipset.netlink.send", count=None)
+        for i in range(6):
+            w.enqueue(f"10.5.0.{i}", 300)
+        # every ban landed through the subprocess shim, none lost
+        _wait(lambda: len(ipset.added) == 6)
+        assert sorted(ipset.added) == sorted(
+            (f"10.5.0.{i}", 300) for i in range(6)
+        )
+        assert sock.sent == []  # netlink never completed a send
+        snap = ipset_stats().prom_snapshot()
+        assert snap["errors"].get("netlink", 0) >= 1
+        assert snap["fallback_total"] == 6
+        assert failpoints.fired_count("ipset.netlink.send") >= 1
+
+        # disarm: netlink resumes (new writer so the breaker state from
+        # the drill cannot route around it)
+        failpoints.disarm("ipset.netlink.send")
+    finally:
+        w.close()
+
+    w2 = nl.IpsetBatchWriter(ipset, flush_interval=0.01)
+    w2._socket = lambda: sock
+    try:
+        before = ipset_stats().prom_snapshot()["batch_entries_total"]
+        w2.enqueue("10.5.1.1", 300)
+        _wait(lambda: ipset_stats().prom_snapshot()["batch_entries_total"]
+              == before + 1)
+        assert len(sock.sent) >= 1
+    finally:
+        w2.close()

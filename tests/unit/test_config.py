@@ -300,3 +300,30 @@ def test_challenge_plane_keys_defaults_and_validation():
     ):
         with pytest.raises(ValueError):
             config_from_yaml_text(bad)
+
+
+def test_serve_fastpath_and_ipset_keys_defaults_and_validation():
+    cfg = config_from_yaml_text("")
+    assert cfg.serve_fastpath_enabled is True
+    assert cfg.serve_decision_table_capacity == 65536
+    assert cfg.ipset_netlink_enabled is True
+
+    cfg = config_from_yaml_text(
+        "serve_fastpath_enabled: false\n"
+        "serve_decision_table_capacity: 1024\n"
+        "ipset_netlink_enabled: false\n"
+    )
+    assert cfg.serve_fastpath_enabled is False
+    assert cfg.serve_decision_table_capacity == 1024
+    assert cfg.ipset_netlink_enabled is False
+
+    for bad in (
+        "serve_decision_table_capacity: 0",
+        "serve_decision_table_capacity: -1",
+        # Go yaml.v2 strictness: wrong-typed values fail the load
+        'serve_fastpath_enabled: "yes"',
+        'serve_decision_table_capacity: "1024"',
+        "ipset_netlink_enabled: banana",
+    ):
+        with pytest.raises(ValueError):
+            config_from_yaml_text(bad)
