@@ -40,6 +40,7 @@ from banjax_tpu.ingest.kafka_io import KafkaReader, KafkaWriter
 from banjax_tpu.ingest.reports import report_status_message
 from banjax_tpu.ingest.tailer import LogTailer
 from banjax_tpu.matcher.cpu_ref import CpuMatcher
+from banjax_tpu.obs import fleet as fleet_mod
 from banjax_tpu.obs import flightrec as flightrec_mod
 from banjax_tpu.obs import provenance, trace
 from banjax_tpu.obs.metrics import MetricsReporter
@@ -263,8 +264,41 @@ class BanjaxApp:
                     self.config_holder.get(), cmd, self.dynamic_lists
                 ),
                 health=self.health,
+                # fleet observability seams (obs/fleet.py): peers pull
+                # this node's metrics over T_STATS, ask it to explain
+                # over T_EXPLAIN, and capture it over T_FLIGHTREC
+                metrics_text_fn=self._render_metrics_text,
+                explain_fn=self._explain_local,
+                health_bits_fn=lambda: fleet_mod.compute_health_bits(
+                    slo=getattr(self, "slo", None),
+                    matcher=getattr(self, "_matcher", None),
+                ),
             )
             self.banner = self.fabric.wrap_banner(self.banner)
+            # forwarded-line bans resolve (origin_node, origin_trace_id)
+            # at record time: the origin index is fed by the owner-side
+            # drain of every forwarded chunk
+            provenance.set_origin_resolver(
+                fleet_mod.get_origin_index().resolve
+            )
+
+        # federated /metrics?fleet=1 (obs/fleet.py FleetScraper): one
+        # merged exposition across every ALIVE member, instance-labeled —
+        # needs the fabric (its peer wire carries the T_STATS pulls)
+        self.fleet_scraper = None
+        if self.fabric is not None and getattr(
+            config, "fleet_metrics_enabled", False
+        ):
+            from banjax_tpu.obs.fleet import FleetScraper
+
+            self.fleet_scraper = FleetScraper(
+                self.fabric.node_id,
+                local_text_fn=self._render_metrics_text,
+                peers_fn=self.fabric.fleet_pull_peers,
+                timeout_s=getattr(
+                    config, "fleet_scrape_timeout_ms", 750.0
+                ) / 1000.0,
+            )
 
         # incident flight recorder (obs/flightrec.py): armed only with a
         # flightrec_dir; installed as the module-level trigger target so
@@ -291,6 +325,17 @@ class BanjaxApp:
                     self._fabric_snapshot if self.fabric is not None
                     else None
                 ),
+                # cluster incident capture: fan T_FLIGHTREC to every
+                # ALIVE peer; each contributes a peers/<node_id>/ tree
+                fleet_capture_fn=(
+                    (lambda incident: fleet_mod.capture_fleet(
+                        incident, self.fabric.fleet_capture_peers
+                    ))
+                    if self.fabric is not None and getattr(
+                        config, "flightrec_fleet_capture", False
+                    )
+                    else None
+                ),
             )
             flightrec_mod.install(self.flightrec)
 
@@ -307,6 +352,23 @@ class BanjaxApp:
                 pipeline_getter=lambda: self.pipeline,
                 on_breach=lambda name, burn: flightrec_mod.notify(
                     f"slo-{name}", f"burn rates {burn}"
+                ),
+            )
+
+        # fleet-mode SLO: a second engine burning the CLUSTER-wide
+        # admitted/shed/stale streams summed across the last federated
+        # scrape (obs/fleet.py fleet_collect) — same window mechanics,
+        # merged denominators
+        self.fleet_slo = None
+        if self.fleet_scraper is not None and getattr(
+            config, "slo_enabled", True
+        ):
+            from banjax_tpu.obs.slo import SloEngine
+
+            self.fleet_slo = SloEngine(
+                collect_fn=self.fleet_scraper.fleet_collect,
+                on_breach=lambda name, burn: flightrec_mod.notify(
+                    f"fleet-slo-{name}", f"fleet burn rates {burn}"
                 ),
             )
 
@@ -394,14 +456,38 @@ class BanjaxApp:
             return {"enabled": False}
         return self.fabric.describe()
 
-    def _fabric_local_submit(self, lines) -> int:
+    def _explain_local(self, ip: str) -> dict:
+        """This node's /decisions/explain payload — served locally AND
+        over the peer wire (T_EXPLAIN) when another shard proxies an
+        explain for an IP this shard owns."""
+        ledger = provenance.get_ledger()
+        active = None
+        peek = getattr(self.dynamic_lists, "peek", None)
+        if peek is not None:
+            ed = peek(ip)
+            if ed is not None:
+                active = {
+                    "decision": str(ed.decision),
+                    "expires": ed.expires,
+                    "domain": ed.domain,
+                    "from_baskerville": ed.from_baskerville,
+                }
+        return {
+            "ip": ip,
+            "ledger_enabled": ledger.enabled,
+            "records": ledger.explain(ip),
+            "active_decision": active,
+        }
+
+    def _fabric_local_submit(self, lines, t_read=None, hop="local") -> int:
         """The single-process consume path — what the fabric router
         calls for lines THIS shard owns (and what every line takes when
-        the fabric is off)."""
+        the fabric is off).  `t_read`/`hop` thread the tailer-read stamp
+        through to the e2e latency histogram (local vs fabric hop)."""
         if self.pipeline is not None:
             # asynchronous: results surface through the pipeline's drain
             # stage; submit() applies bounded backpressure to the tailer
-            self.pipeline.submit(lines)
+            self.pipeline.submit(lines, t_read=t_read, hop=hop)
             return len(lines)
         cfg, matcher = self._current_matcher()
         results = matcher.consume_lines(lines)
@@ -443,15 +529,18 @@ class BanjaxApp:
         return cfg, self._matcher
 
     def _consume_lines(self, lines):
+        # tailer-read stamp: the e2e latency histogram measures from
+        # HERE to effector commit, per hop (local vs fabric)
+        t_read = time.monotonic()
         if self.fabric is not None:
             # keyspace-sharded: owned lines go down the local pipeline,
             # the rest ride peer sockets to their owning shard
-            self.fabric.submit(lines)
+            self.fabric.submit(lines, t_read=t_read)
             return None
         if self.pipeline is not None:
             # asynchronous: results surface through the pipeline's drain
             # stage; submit() applies bounded backpressure to the tailer
-            self.pipeline.submit(lines)
+            self.pipeline.submit(lines, t_read=t_read)
             return None
         cfg, matcher = self._current_matcher()
         results = matcher.consume_lines(lines)
@@ -471,6 +560,8 @@ class BanjaxApp:
             self.pipeline.start()
         if self.slo is not None:
             self.slo.start(getattr(config, "slo_sample_seconds", 15.0))
+        if self.fleet_slo is not None:
+            self.fleet_slo.start(getattr(config, "slo_sample_seconds", 15.0))
         self.tailer.start()
 
         # kafka→pipeline routing: command messages share the pipeline's
@@ -542,6 +633,8 @@ class BanjaxApp:
             fabric_getter=lambda: (
                 self.fabric.stats if self.fabric is not None else None
             ),
+            fleet_getter=lambda: self.fleet_scraper,
+            fabric_service_getter=lambda: self.fabric,
             challenge_verifier=self.challenge_verifier,
             decision_table=self.decision_table,
         )
@@ -619,6 +712,12 @@ class BanjaxApp:
             self.pipeline.stop()
         if self.slo is not None:
             self.slo.stop()
+        if self.fleet_slo is not None:
+            self.fleet_slo.stop()
+        # uninstall the module-level origin resolver so a later app in
+        # the same process (in-process tests) starts clean
+        if self.fabric is not None:
+            provenance.set_origin_resolver(None)
         if self.flightrec is not None:
             # uninstall the module-level trigger target so a later app in
             # the same process (in-process tests) starts clean

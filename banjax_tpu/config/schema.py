@@ -443,6 +443,23 @@ class Config:
     # per-direction ring capacity in bytes (power of two, and must
     # exceed fabric_frame_max_bytes — a frame is written atomically)
     fabric_shm_ring_bytes: int = 1 << 21
+    # --- fleet observability plane (banjax_tpu/obs/fleet.py) ---
+    # forwarded chunks carry (origin node id, origin trace id) on the
+    # wire and owner-side drains open linked fabric.remote-drain spans +
+    # feed the provenance origin resolver — the cross-host trace join.
+    # Inert without a live tracer/fabric; adds bytes per data frame.
+    fabric_trace_propagation: bool = False
+    # /metrics?fleet=1 (admin-gated): fan a metrics pull out to every
+    # ALIVE member and serve ONE merged exposition with instance labels
+    fleet_metrics_enabled: bool = False
+    # per-peer budget for one federated metrics pull; a peer that cannot
+    # answer within it is served from its cached snapshot (flagged
+    # stale) or flagged unreachable — the scrape itself never fails
+    fleet_scrape_timeout_ms: float = 750.0
+    # incident capture fan-out: an incident on THIS node also collects
+    # trace/metrics/provenance/fabric snapshots from every ALIVE peer
+    # into the bundle's peers/<node_id>/ tree
+    flightrec_fleet_capture: bool = False
     # --- challenge plane (banjax_tpu/challenge/) ---
     # device-batched PoW verification (challenge/verifier.py + matcher/
     # kernels/pow_verify.py): route the sha-inv leading-zero check through
@@ -548,6 +565,8 @@ _SCALAR_KEYS = {
     "fabric_inflight_frames": int, "fabric_wire_v2": bool,
     "fabric_frame_max_bytes": int, "fabric_shm_enabled": bool,
     "fabric_shm_ring_bytes": int,
+    "fabric_trace_propagation": bool, "fleet_metrics_enabled": bool,
+    "fleet_scrape_timeout_ms": float, "flightrec_fleet_capture": bool,
     "challenge_device_verify": bool, "challenge_verify_batch_max": int,
     "challenge_failure_state_max": int,
     "serve_fastpath_enabled": bool, "serve_decision_table_capacity": int,
@@ -838,6 +857,11 @@ def config_from_yaml_text(text: str, standalone_testing_default: bool = False) -
             "config key fabric_shm_ring_bytes: must exceed "
             "fabric_frame_max_bytes (a frame is ring-written atomically), "
             f"got {cfg.fabric_shm_ring_bytes} <= {cfg.fabric_frame_max_bytes}"
+        )
+    if cfg.fleet_scrape_timeout_ms <= 0:
+        raise ValueError(
+            "config key fleet_scrape_timeout_ms: expected positive, got "
+            f"{cfg.fleet_scrape_timeout_ms}"
         )
     if cfg.flightrec_keep < 1 or cfg.flightrec_provenance_records < 1:
         raise ValueError(

@@ -177,6 +177,7 @@ class FabricDryrun:
         log_dir: Optional[str] = None,
         transport: str = "v2",
         inflight_frames: int = 8,
+        fleet_obs: bool = False,
     ):
         if transport not in ("json", "v2", "shm"):
             raise ValueError(f"unknown transport {transport!r}")
@@ -201,6 +202,10 @@ class FabricDryrun:
         self.kill_frac = kill_frac
         self.transport = transport
         self.inflight_frames = inflight_frames
+        # fleet observability drill arm: workers propagate origin trace
+        # context on every forward and serve the T_EXPLAIN/T_FLIGHTREC/
+        # T_STATS-metrics fleet surface — decisions must not change
+        self.fleet_obs = fleet_obs
         self.ready_timeout_s = ready_timeout_s
         self.settle_timeout_s = settle_timeout_s
         self.log_dir = log_dir
@@ -409,6 +414,7 @@ class FabricDryrun:
             ),
             "wire_v2": self.transport != "json",
             "shm": self.transport == "shm",
+            "trace_propagation": self.fleet_obs,
         }
         if self.churn:
             payload.update({
@@ -424,7 +430,12 @@ class FabricDryrun:
                 os.path.join(self.log_dir, f"{wid}.err")
                 if self.log_dir else None
             )
-            self.workers[wid] = _spawn(wid, self.broker.port, err_path)
+            extra = (
+                ("--trace-propagation", "1") if self.fleet_obs else ()
+            )
+            self.workers[wid] = _spawn(
+                wid, self.broker.port, err_path, extra_args=extra
+            )
         threads = [
             threading.Thread(
                 target=w.read_ready, args=(self.ready_timeout_s,),

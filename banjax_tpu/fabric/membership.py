@@ -106,10 +106,15 @@ class SwimMembership:
         peer_factory: Optional[Callable[[str, str, int], Any]] = None,
         clock: Callable[[], float] = time.monotonic,
         rng_seed: Optional[int] = None,
+        health_provider: Optional[Callable[[], int]] = None,
     ):
         self.node_id = node_id
         self.router = router
         self.stats = stats or FabricStats()
+        # compact per-node health bits (obs/fleet.py encoding) that ride
+        # every gossip frame as a parallel "health" key — digest rows
+        # stay the strict 5-tuple old nodes unpack
+        self.health_provider = health_provider
         self.interval_s = float(gossip_interval_ms) / 1000.0
         self.suspect_timeout_s = float(suspect_timeout_ms) / 1000.0
         self.indirect_probes = int(indirect_probes)
@@ -188,6 +193,31 @@ class SwimMembership:
             ))
         self._dispatch(events)
         return events
+
+    def _health_map(self) -> Dict[str, int]:
+        """Everything this node knows about fleet health: learned peer
+        bits plus its own freshly-sampled bits (last writer wins on the
+        receiving side; our own entry is always recomputed, never
+        echoed back stale)."""
+        out = self.stats.peer_health_snapshot()
+        if self.health_provider is not None:
+            try:
+                out[self.node_id] = int(self.health_provider())
+            except Exception:  # health must never break gossip
+                pass
+        return out
+
+    def merge_health(self, health: Any) -> None:
+        """Absorb a received "health" piggyback map."""
+        if not isinstance(health, dict):
+            return
+        for nid, bits in health.items():
+            if str(nid) == self.node_id:
+                continue  # own bits come from health_provider only
+            try:
+                self.stats.note_peer_health(str(nid), int(bits))
+            except (TypeError, ValueError):
+                continue
 
     # ---- transitions (the one funnel) ----
 
@@ -355,8 +385,10 @@ class SwimMembership:
         with mode=sleep to fake a slow-but-alive node."""
         failpoints.check("fabric.gossip.ack")
         self.merge(payload.get("digest"), via=str(payload.get("from", "")))
+        self.merge_health(payload.get("health"))
         return wire.T_GOSSIP_ACK, {
-            "node_id": self.node_id, "digest": self.digest()
+            "node_id": self.node_id, "digest": self.digest(),
+            "health": self._health_map(),
         }
 
     def handle_ping_req(self, payload: dict) -> Tuple[int, dict]:
@@ -364,6 +396,7 @@ class SwimMembership:
         (SWIM indirect probe — a one-hop path around a partitioned
         direct link)."""
         self.merge(payload.get("digest"), via=str(payload.get("from", "")))
+        self.merge_health(payload.get("health"))
         target = str(payload.get("target", ""))
         with self._lock:
             m = self._members.get(target)
@@ -372,7 +405,8 @@ class SwimMembership:
         if addr is not None:
             ok = self._probe(target, addr[0], addr[1])
         return wire.T_GOSSIP_ACK, {
-            "node_id": self.node_id, "ok": ok, "digest": self.digest()
+            "node_id": self.node_id, "ok": ok, "digest": self.digest(),
+            "health": self._health_map(),
         }
 
     def handle_join(self, payload: dict) -> Tuple[int, dict]:
@@ -484,10 +518,11 @@ class SwimMembership:
             resp = self._send(
                 relay.host, relay.port, wire.T_GOSSIP_PING_REQ,
                 {"from": self.node_id, "target": target,
-                 "digest": self.digest()},
+                 "digest": self.digest(), "health": self._health_map()},
             )
             if resp is not None:
                 self.merge(resp.get("digest"), via=relay.node_id)
+                self.merge_health(resp.get("health"))
                 if resp.get("ok"):
                     return True
         return False
@@ -495,11 +530,13 @@ class SwimMembership:
     def _probe(self, nid: str, host: str, port: int) -> bool:
         resp = self._send(
             host, port, wire.T_GOSSIP_PING,
-            {"from": self.node_id, "digest": self.digest()},
+            {"from": self.node_id, "digest": self.digest(),
+             "health": self._health_map()},
         )
         if resp is None:
             return False
         self.merge(resp.get("digest"), via=nid)
+        self.merge_health(resp.get("health"))
         return True
 
     def _send(self, host: str, port: int, ftype: int,

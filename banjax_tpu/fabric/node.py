@@ -97,6 +97,9 @@ class FabricNode:
         if ftype == wire.T_VERSION:
             return wire.T_VERSION_R, {
                 "wire": wire.WIRE_VERSION, "ring": self.allow_rings,
+                # origin-section support (wire._V2_TRACE): senders only
+                # set the trace bit against a peer that advertised it
+                "trace": True,
             }
         handler = self.handlers.get(ftype)
         if handler is None:

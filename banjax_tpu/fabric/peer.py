@@ -164,14 +164,15 @@ def _corrupt_frame(frame: bytes) -> bytes:
 
 
 class _InflightFrame:
-    __slots__ = ("seq", "groups", "replay", "n_lines", "sent_at")
+    __slots__ = ("seq", "groups", "replay", "n_lines", "sent_at", "t_read")
 
-    def __init__(self, seq, groups, replay, n_lines, sent_at):
+    def __init__(self, seq, groups, replay, n_lines, sent_at, t_read=None):
         self.seq = seq
-        self.groups = groups          # the coalesced routed groups
+        self.groups = groups   # coalesced (lines, origin trace id) groups
         self.replay = replay
         self.n_lines = n_lines
         self.sent_at = sent_at
+        self.t_read = t_read   # oldest tailer-read stamp in the frame
 
 
 class LinePipe:
@@ -200,6 +201,7 @@ class LinePipe:
         stop: Optional[threading.Event] = None,
         stats=None,
         on_ack: Optional[Callable[[Dict[str, Any]], None]] = None,
+        trace_propagation: bool = False,
     ):
         self.peer_id = peer_id
         self.host = host
@@ -222,6 +224,11 @@ class LinePipe:
         self._stop = stop or threading.Event()
         self.stats = stats
         self.on_ack = on_ack
+        # cross-host trace propagation (obs/fleet.py): forwarded frames
+        # carry (origin node, origin trace id, tailer-read stamp) when
+        # on AND the peer advertised origin-section support at handshake
+        self.trace_propagation = bool(trace_propagation)
+        self._peer_trace = False
         # negotiated per connection; read for introspection/metrics
         self.mode = "v2" if self.wire_v2 else "json"
         self.transport = "tcp"
@@ -245,11 +252,15 @@ class LinePipe:
 
     # ---- producer API ----
 
-    def submit(self, lines, replay: bool = False) -> None:
+    def submit(self, lines, replay: bool = False, trace_id: int = 0,
+               t_read: Optional[float] = None) -> None:
         """Enqueue one routed group.  Returns as soon as the group is
         in the outbox (backpressure-bounded); raises PeerUnavailable
         when the link is dead or its breaker is open — the router then
-        starts the takeover, exactly like a failed synchronous send."""
+        starts the takeover, exactly like a failed synchronous send.
+
+        `trace_id`/`t_read` ride the frame's origin section when trace
+        propagation is negotiated; both are free to ignore otherwise."""
         if not self.breaker.allow():
             raise PeerUnavailable(
                 f"peer {self.peer_id}: breaker {self.breaker.state}"
@@ -265,7 +276,9 @@ class LinePipe:
                 raise PeerUnavailable(
                     f"peer {self.peer_id} pipe dead: {self._dead_reason}"
                 )
-            self._pending.append((tuple(lines), bool(replay)))
+            self._pending.append(
+                (tuple(lines), bool(replay), int(trace_id), t_read)
+            )
             was_empty = len(self._pending) == 1
         # wake the I/O thread only on the empty->nonempty transition:
         # in every other sleeping state it is already ack-driven (a
@@ -382,6 +395,7 @@ class LinePipe:
         )
         sock.settimeout(self.send_timeout_s)
         mode, server_ring = "json", False
+        self._peer_trace = False
         if self.wire_v2:
             wire.send_frame(sock, wire.T_VERSION, {
                 "wire": wire.WIRE_VERSION, "node": self.node_id,
@@ -393,6 +407,7 @@ class LinePipe:
             ):
                 mode = "v2"
                 server_ring = bool(rpayload.get("ring"))
+                self._peer_trace = bool(rpayload.get("trace"))
             # T_ERR ("unhandled frame type") => a JSON-only peer:
             # negotiate down losslessly
         self._sock = sock
@@ -553,18 +568,24 @@ class LinePipe:
             replay = self._pending[0][1]
             size = 64
             n_lines = 0
+            t_read: Optional[float] = None
             while self._pending and self._pending[0][1] == replay:
-                lines, _rp = self._pending[0]
+                lines, _rp, trace_id, grp_t_read = self._pending[0]
                 est = sum(len(ln) + 4 for ln in lines)
                 if groups and size + est > self.frame_max_bytes:
                     break
                 self._pending.popleft()
-                groups.append(lines)
+                groups.append((lines, trace_id))
                 size += est
                 n_lines += len(lines)
+                if grp_t_read is not None and (
+                    t_read is None or grp_t_read < t_read
+                ):
+                    t_read = grp_t_read
             seq = self._next_seq
             self._next_seq += 1
-            fr = _InflightFrame(seq, groups, replay, n_lines, time.monotonic())
+            fr = _InflightFrame(seq, groups, replay, n_lines,
+                                time.monotonic(), t_read=t_read)
             self._inflight[seq] = fr
             n_inflight = len(self._inflight)
             self._cv.notify_all()
@@ -576,14 +597,35 @@ class LinePipe:
         failpoints.check("fabric.send")
         fr.sent_at = time.monotonic()
         flat: List[str] = []
-        for g in fr.groups:
+        runs: List[tuple] = []  # contiguous (origin trace id, count) runs
+        for g, trace_id in fr.groups:
             flat.extend(g)
+            if runs and runs[-1][0] == trace_id:
+                runs[-1] = (trace_id, runs[-1][1] + len(g))
+            else:
+                runs.append((trace_id, len(g)))
+        propagate = self.trace_propagation and self.node_id
         if self.mode == "v2":
-            frame = wire.encode_lines_v2(fr.seq, flat, replay=fr.replay)
+            if propagate and self._peer_trace:
+                frame = wire.encode_lines_v2(
+                    fr.seq, flat, replay=fr.replay,
+                    origin_node=self.node_id,
+                    origin_t_read=fr.t_read or 0.0,
+                    origin_runs=runs,
+                )
+            else:
+                frame = wire.encode_lines_v2(fr.seq, flat, replay=fr.replay)
         else:
-            frame = wire.encode_frame(wire.T_LINES, {
-                "lines": flat, "replay": fr.replay, "seq": fr.seq,
-            })
+            payload = {"lines": flat, "replay": fr.replay, "seq": fr.seq}
+            if propagate:
+                # the JSON fallback carries the same origin info as a
+                # plain key — old receivers ignore unknown keys
+                payload["origin"] = {
+                    "node": self.node_id,
+                    "runs": [[t, c] for t, c in runs],
+                    "t_read": fr.t_read,
+                }
+            frame = wire.encode_frame(wire.T_LINES, payload)
         try:
             failpoints.check("fabric.frame.corrupt")
         except failpoints.FaultInjected:

@@ -51,6 +51,7 @@ could only double-process).
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from collections import deque
@@ -60,6 +61,7 @@ from banjax_tpu.fabric.hashring import ConsistentHashRing
 from banjax_tpu.fabric.peer import LinePipe, PeerClient, PeerUnavailable
 from banjax_tpu.fabric.stats import FabricStats
 from banjax_tpu.fabric import wire
+from banjax_tpu.obs import trace
 from banjax_tpu.resilience import failpoints
 from banjax_tpu.resilience.health import HealthRegistry
 
@@ -91,11 +93,27 @@ class FabricRouter:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         pipe_factory: Optional[PipeFactory] = None,
+        trace_propagation: bool = False,
     ):
         self.node_id = node_id
         self.ring = ring
         self.peers = peers
         self.local_submit = local_submit
+        # origin trace ids ride forwarded chunks only when configured
+        # (fabric_trace_propagation) — inert with the tracer off, since
+        # new_trace() then returns 0 and the wire omits the section
+        self.trace_propagation = bool(trace_propagation)
+        # whether local_submit accepts the (t_read, hop) latency-stamp
+        # keywords — probed once so plain `lambda lines: n` callables
+        # (tests, simple drivers) keep working unchanged
+        try:
+            params = inspect.signature(local_submit).parameters
+            self._local_kw = "t_read" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+        except (TypeError, ValueError):
+            self._local_kw = False
         self.stats = stats or FabricStats()
         self.health = health
         self.takeover_grace_s = float(takeover_grace_ms) / 1000.0
@@ -130,21 +148,45 @@ class FabricRouter:
 
     # ---- routing ----
 
-    def route(self, lines: Sequence[str], replay: bool = False) -> Dict[str, int]:
+    def route(
+        self, lines: Sequence[str], replay: bool = False,
+        t_read: Optional[float] = None,
+    ) -> Dict[str, int]:
         """Deliver every line to its owner.  Returns the disposition
         ledger {local, forwarded, shed, skipped} — their sum is always
         len(lines).  `skipped` is only ever nonzero on a replay: lines
         whose pre-death owner is still alive were already processed
         once, and replaying them would double-count rate-limit hits
-        (the n2 duplicate-ban bug)."""
+        (the n2 duplicate-ban bug).  `t_read` is the tailer-read
+        monotonic stamp of the chunk (e2e latency; rides the wire with
+        forwarded groups)."""
         self.poll()  # complete any takeover whose grace deadline passed
         out = {"local": 0, "forwarded": 0, "shed": 0, "skipped": 0}
-        with self._lock:
-            self._route_locked(list(lines), out, replay)
+        # the origin trace: allocated HERE, before ownership fans the
+        # chunk out, so a ban minted on any owner shard joins back to
+        # this admission batch (0 = tracer off: the wire section and
+        # every span call no-op)
+        tid = trace.new_trace() if self.trace_propagation else 0
+        span = trace.begin("fabric.route", tid, args={"lines": len(lines)})
+        try:
+            with self._lock:
+                self._route_locked(list(lines), out, replay, tid, t_read)
+        finally:
+            span.note("disposition", dict(out))
+            trace.end(span)
         return out
 
+    def _local_call(
+        self, group: List[str], t_read: Optional[float], hop: str
+    ) -> None:
+        if self._local_kw:
+            self.local_submit(group, t_read=t_read, hop=hop)
+        else:
+            self.local_submit(group)
+
     def _route_locked(
-        self, lines: List[str], out: Dict[str, int], replay: bool
+        self, lines: List[str], out: Dict[str, int], replay: bool,
+        trace_id: int = 0, t_read: Optional[float] = None,
     ) -> None:
         if not lines:
             return
@@ -162,15 +204,19 @@ class FabricRouter:
         for owner, idxs in by_owner.items():
             group = [lines[i] for i in idxs]
             if owner == self.node_id or self.peers.get(owner) is None:
-                self.local_submit(group)
+                self._local_call(group, t_read, "local")
                 self.stats.note_local(len(group))
                 out["local"] += len(group)
                 continue
             pipe = self._pipe_for_locked(owner)
             if pipe is not None:
-                self._forward_pipelined_locked(owner, pipe, group, out, replay)
+                self._forward_pipelined_locked(
+                    owner, pipe, group, out, replay, trace_id, t_read
+                )
             else:
-                self._forward_sync_locked(owner, group, out, replay)
+                self._forward_sync_locked(
+                    owner, group, out, replay, trace_id, t_read
+                )
 
     def _filter_replay_locked(
         self, lines: List[str], out: Dict[str, int]
@@ -200,6 +246,7 @@ class FabricRouter:
     def _forward_pipelined_locked(
         self, owner: str, pipe: LinePipe, group: List[str],
         out: Dict[str, int], replay: bool,
+        trace_id: int = 0, t_read: Optional[float] = None,
     ) -> None:
         """Wire v2 data path: journal at submit (the takeover replay
         source), hand the group to the peer's pipelined window, return
@@ -207,7 +254,8 @@ class FabricRouter:
         entry = tuple(group)
         self._journal[owner].append(entry)
         try:
-            pipe.submit(group, replay=replay)
+            pipe.submit(group, replay=replay, trace_id=trace_id,
+                        t_read=t_read)
         except PeerUnavailable:
             # the group never entered the window: pull it back out of
             # the journal (first equal chunk — same multiset) and
@@ -217,7 +265,7 @@ class FabricRouter:
             except ValueError:
                 pass
             self.mark_dead(owner, reason="pipe dead")
-            self._route_locked(group, out, replay)
+            self._route_locked(group, out, replay, trace_id, t_read)
             return
         self.stats.note_forwarded(len(group))
         out["forwarded"] += len(group)
@@ -225,17 +273,25 @@ class FabricRouter:
     def _forward_sync_locked(
         self, owner: str, group: List[str],
         out: Dict[str, int], replay: bool,
+        trace_id: int = 0, t_read: Optional[float] = None,
     ) -> None:
         """The PR 11 synchronous JSON path — kept verbatim as the
         negotiated fallback and the differential oracle
         (fabric_inflight_frames = 0)."""
+        payload: Dict[str, object] = {"lines": group, "replay": replay}
+        if self.trace_propagation and self.node_id:
+            # same origin section the v2 binary frame carries; old
+            # receivers ignore the unknown key
+            payload["origin"] = {
+                "node": self.node_id,
+                "runs": [[trace_id, len(group)]],
+                "t_read": t_read,
+            }
         try:
-            _rt, rpayload = self.peers[owner].request(
-                wire.T_LINES, {"lines": group, "replay": replay}
-            )
+            _rt, rpayload = self.peers[owner].request(wire.T_LINES, payload)
         except PeerUnavailable:
             self.mark_dead(owner, reason="send failed")
-            self._route_locked(group, out, replay)
+            self._route_locked(group, out, replay, trace_id, t_read)
             return
         self.stats.note_forwarded(len(group))
         out["forwarded"] += len(group)
@@ -248,6 +304,24 @@ class FabricRouter:
             piggy = rpayload.get("gossip")
             if piggy:
                 self.gossip_merge(piggy)
+
+    def owner_of(self, ip: str) -> Optional[str]:
+        """Current owner of one key under the alive view (the
+        cross-shard /decisions/explain proxy asks this before deciding
+        whether to answer locally or over the peer wire)."""
+        with self._lock:
+            if not self.alive:
+                return None
+            return self.ring.owner(ip, self.alive)
+
+    def alive_peers(self) -> Dict[str, PeerClient]:
+        """{peer_id: client} for every ALIVE remote member — the fleet
+        scrape / incident-capture fan-out set."""
+        with self._lock:
+            return {
+                pid: c for pid, c in self.peers.items()
+                if c is not None and pid in self.alive
+            }
 
     # ---- pipelined data path plumbing ----
 

@@ -49,6 +49,9 @@ class FabricStats:
         self.gossip_bytes = 0              # probe frames + piggyback digests
         self.member_state: Dict[str, str] = {}  # peer -> alive/suspect/dead/left
         self.detection_time = Histogram()  # last liveness evidence -> confirmed dead
+        # node -> health bits piggybacked on gossip (obs/fleet.py
+        # HEALTH_* encoding: 1 slo_breached, 2 breaker open, 4 half-open)
+        self.peer_health: Dict[str, int] = {}
         # ---- wire v2 transport (fabric/peer.py LinePipe) ----
         self.frames_sent: Dict[Tuple[str, str], int] = {}  # (version, transport)
         self.frame_bytes_total = 0
@@ -145,6 +148,14 @@ class FabricStats:
     def note_gossip_bytes(self, n: int) -> None:
         with self._lock:
             self.gossip_bytes += n
+
+    def note_peer_health(self, peer_id: str, bits: int) -> None:
+        with self._lock:
+            self.peer_health[peer_id] = int(bits)
+
+    def peer_health_snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.peer_health)
 
     def note_detection(self, duration_s: float) -> None:
         """Failure-detection latency: last liveness evidence for the

@@ -14,17 +14,28 @@ Two body encodings share that header:
     frames in order on one connection.
   * **binary v2** (`T_LINES_V2`) — the data-path hot frame.  Zero JSON
     on the hot path: a `u64` journal sequence, a `u8` flags byte
-    (bit 0 = replay), a `u32` line count, a `(count+1)`-entry `u32`
-    offset table and the raw UTF-8 line blob.  `decode_lines_v2`
-    validates the offset table strictly (monotone, zero-based, last
-    entry == blob length) so a corrupt frame raises `FrameError`
-    instead of delivering garbled lines.
+    (bit 0 = replay, bit 1 = origin section present), a `u32` line
+    count, a `(count+1)`-entry `u32` offset table and the raw UTF-8
+    line blob.  `decode_lines_v2` validates the offset table strictly
+    (monotone, zero-based, last entry == blob length) so a corrupt
+    frame raises `FrameError` instead of delivering garbled lines.
+
+    With bit 1 set an **origin section** follows the blob: the sender's
+    node id, the tailer-read monotonic timestamp of the oldest line in
+    the frame, and a run table of `(trace_id u64, count u32)` pairs
+    mapping contiguous line runs back to the admission trace that
+    routed them on the origin shard (obs/fleet.py joins a ban on the
+    owner back to that trace).  The run counts must sum exactly to the
+    line count — a frame that lies about its runs fails decode loudly,
+    like every other v2 invariant.
 
 `T_VERSION` is the connect-time handshake: a v2 sender probes with
-`{"wire": 2}`; a v2 node answers `T_VERSION_R` with its wire version
-(and whether it accepts shm-ring attaches), while an old node answers
-T_ERR ("unhandled frame type") — the sender then negotiates down to
-per-frame JSON losslessly.
+`{"wire": 2}`; a v2 node answers `T_VERSION_R` with its wire version,
+whether it accepts shm-ring attaches, and whether it understands the
+origin section (`"trace": true` — senders only set bit 1 against a
+peer that advertised it), while an old node answers T_ERR ("unhandled
+frame type") — the sender then negotiates down to per-frame JSON
+losslessly.
 """
 
 from __future__ import annotations
@@ -33,7 +44,7 @@ import dataclasses
 import json
 import socket
 import struct
-from typing import Any, Dict, List, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 MAX_FRAME_BYTES = 32 << 20  # one scenario chunk is ~32 KiB; 32 MiB is sabotage
 MAX_V2_LINES = 1 << 22      # offset-table sanity bound, far above any frame
@@ -43,6 +54,11 @@ WIRE_VERSION = 2
 _HEADER = struct.Struct("!IB")
 _V2_FIXED = struct.Struct("!QBI")  # seq u64, flags u8, count u32
 _V2_REPLAY = 0x01
+_V2_TRACE = 0x02   # origin section follows the line blob
+# origin section: node_len u16, t_read f64 (monotonic s), run_count u32
+_V2_ORIGIN_FIXED = struct.Struct("!HdI")
+_V2_RUN = struct.Struct("!QI")     # origin trace_id u64, line count u32
+MAX_V2_NODE_LEN = 256
 
 # frame types — request/response pairs share a row
 T_HELLO = 1        # -> T_HELLO_R     driver/peer handshake, topology push
@@ -72,6 +88,10 @@ T_LINES_V2 = 24    # -> T_ACK         binary batched line frame (wire v2)
 T_VERSION = 26     # -> T_VERSION_R   wire-version handshake at connect
 T_VERSION_R = 27
 T_RING_ATTACH = 28  # -> T_ACK        co-located peer: switch to shm rings
+T_FLIGHTREC = 29   # -> T_FLIGHTREC_R fleet incident capture: obs snapshot
+T_FLIGHTREC_R = 30
+T_EXPLAIN = 31     # -> T_EXPLAIN_R   cross-shard /decisions/explain proxy
+T_EXPLAIN_R = 32
 
 
 class FrameError(OSError):
@@ -81,31 +101,97 @@ class FrameError(OSError):
 @dataclasses.dataclass(frozen=True)
 class LinesV2:
     """A decoded T_LINES_V2 frame: the journal seq the ack must echo,
-    the replay flag, and the batched lines."""
+    the replay flag, the batched lines, and — when the sender set the
+    trace bit — the origin section (which shard tailed these lines,
+    when its tailer read them, and which admission trace routed each
+    contiguous run)."""
 
     seq: int
     replay: bool
     lines: Tuple[str, ...]
+    origin_node: str = ""
+    origin_t_read: float = 0.0
+    origin_runs: Tuple[Tuple[int, int], ...] = ()
 
 
 def encode_lines_v2(
-    seq: int, lines: Sequence[str], replay: bool = False
+    seq: int,
+    lines: Sequence[str],
+    replay: bool = False,
+    origin_node: str = "",
+    origin_t_read: float = 0.0,
+    origin_runs: Optional[Sequence[Tuple[int, int]]] = None,
 ) -> bytes:
     """One complete T_LINES_V2 frame (header included), ready for
     sendall/ring-write.  Many routed groups coalesce into one call —
-    the encoder only sees the flattened line list."""
+    the encoder only sees the flattened line list (plus, with
+    `origin_node`, the per-run trace table covering it)."""
     blobs = [ln.encode("utf-8") for ln in lines]
     offsets: List[int] = [0]
     for b in blobs:
         offsets.append(offsets[-1] + len(b))
-    body = b"".join((
-        _V2_FIXED.pack(seq, _V2_REPLAY if replay else 0, len(blobs)),
+    flags = _V2_REPLAY if replay else 0
+    parts = [
+        _V2_FIXED.pack(seq, flags, len(blobs)),
         struct.pack(f"!{len(offsets)}I", *offsets),
         b"".join(blobs),
-    ))
+    ]
+    if origin_node:
+        node_b = origin_node.encode("utf-8")
+        if len(node_b) > MAX_V2_NODE_LEN:
+            raise FrameError(f"origin node id too long: {len(node_b)} bytes")
+        runs = list(origin_runs) if origin_runs else [(0, len(blobs))]
+        if sum(c for _t, c in runs) != len(blobs):
+            raise FrameError(
+                "origin run counts do not cover the line count"
+            )
+        parts[0] = _V2_FIXED.pack(seq, flags | _V2_TRACE, len(blobs))
+        parts.append(_V2_ORIGIN_FIXED.pack(
+            len(node_b), float(origin_t_read), len(runs)
+        ))
+        parts.append(node_b)
+        parts.extend(_V2_RUN.pack(int(t), int(c)) for t, c in runs)
+    body = b"".join(parts)
     if 1 + len(body) > MAX_FRAME_BYTES:
         raise FrameError(f"frame too large: {len(body)} bytes")
     return _HEADER.pack(1 + len(body), T_LINES_V2) + body
+
+
+def _decode_origin(
+    body: bytes, start: int, count: int
+) -> Tuple[str, float, Tuple[Tuple[int, int], ...]]:
+    """Strict origin-section decode (trace bit set): exact length, node
+    UTF-8, run counts summing to the frame's line count."""
+    if len(body) < start + _V2_ORIGIN_FIXED.size:
+        raise FrameError("v2 origin section truncated")
+    node_len, t_read, run_count = _V2_ORIGIN_FIXED.unpack_from(body, start)
+    if node_len > MAX_V2_NODE_LEN:
+        raise FrameError(f"v2 origin node length {node_len} oversized")
+    if run_count > max(1, count):
+        raise FrameError(
+            f"v2 origin run count {run_count} exceeds line count {count}"
+        )
+    pos = start + _V2_ORIGIN_FIXED.size
+    end = pos + node_len + run_count * _V2_RUN.size
+    if len(body) != end:
+        raise FrameError(
+            f"v2 origin section length mismatch: need {end - start}, "
+            f"have {len(body) - start}"
+        )
+    try:
+        node = body[pos:pos + node_len].decode("utf-8")
+    except UnicodeDecodeError as exc:
+        raise FrameError(f"v2 origin node not UTF-8: {exc}") from exc
+    pos += node_len
+    runs = tuple(
+        _V2_RUN.unpack_from(body, pos + i * _V2_RUN.size)
+        for i in range(run_count)
+    )
+    if sum(c for _t, c in runs) != count:
+        raise FrameError(
+            "v2 origin run counts do not cover the line count"
+        )
+    return node, t_read, runs
 
 
 def decode_lines_v2(body: bytes) -> LinesV2:
@@ -123,14 +209,27 @@ def decode_lines_v2(body: bytes) -> LinesV2:
             f"v2 offset table truncated: need {table_end}, have {len(body)}"
         )
     offsets = struct.unpack_from(f"!{count + 1}I", body, _V2_FIXED.size)
-    blob = body[table_end:]
     if offsets[0] != 0:
         raise FrameError(f"v2 offset table must start at 0, got {offsets[0]}")
-    if offsets[-1] != len(blob):
-        raise FrameError(
-            f"v2 blob length mismatch: table says {offsets[-1]}, "
-            f"blob is {len(blob)} bytes"
+    origin_node, origin_t_read = "", 0.0
+    origin_runs: Tuple[Tuple[int, int], ...] = ()
+    if flags & _V2_TRACE:
+        blob = body[table_end:table_end + offsets[-1]]
+        if len(blob) != offsets[-1]:
+            raise FrameError(
+                f"v2 blob truncated: table says {offsets[-1]}, "
+                f"have {len(blob)} bytes"
+            )
+        origin_node, origin_t_read, origin_runs = _decode_origin(
+            body, table_end + offsets[-1], count
         )
+    else:
+        blob = body[table_end:]
+        if offsets[-1] != len(blob):
+            raise FrameError(
+                f"v2 blob length mismatch: table says {offsets[-1]}, "
+                f"blob is {len(blob)} bytes"
+            )
     prev = 0
     for off in offsets:
         if off < prev:
@@ -143,7 +242,11 @@ def decode_lines_v2(body: bytes) -> LinesV2:
         )
     except UnicodeDecodeError as exc:
         raise FrameError(f"v2 line blob not UTF-8: {exc}") from exc
-    return LinesV2(seq=seq, replay=bool(flags & _V2_REPLAY), lines=lines)
+    return LinesV2(
+        seq=seq, replay=bool(flags & _V2_REPLAY), lines=lines,
+        origin_node=origin_node, origin_t_read=origin_t_read,
+        origin_runs=origin_runs,
+    )
 
 
 def encode_frame(ftype: int, payload: Dict[str, Any]) -> bytes:

@@ -77,6 +77,10 @@ def main(argv=None) -> int:
                     help="1 = co-located peers exchange frames over "
                          "shm rings instead of loopback TCP")
     ap.add_argument("--shm-ring-bytes", type=int, default=1 << 21)
+    ap.add_argument("--trace-propagation", type=int, default=0,
+                    help="1 = forwarded chunks carry (origin node, "
+                         "origin trace id) and owner-side drains open "
+                         "linked fabric.remote-drain spans")
     ap.add_argument("--join", default="",
                     help="host:port of one live member — join its ring "
                          "via gossip announce + snapshot sync instead of "
@@ -97,7 +101,10 @@ def main(argv=None) -> int:
     from banjax_tpu.fabric.router import FabricRouter
     from banjax_tpu.fabric.hashring import ConsistentHashRing
     from banjax_tpu.fabric.stats import FabricStats
+    from banjax_tpu.fabric.router import ip_of_line
     from banjax_tpu.ingest.kafka_io import handle_command
+    from banjax_tpu.obs import fleet, provenance, trace
+    from banjax_tpu.obs.exposition import render_prometheus
     from banjax_tpu.resilience import failpoints
     from banjax_tpu.resilience.health import HealthRegistry
     from banjax_tpu.scenarios.runtime import (
@@ -146,6 +153,13 @@ def main(argv=None) -> int:
         },
     )
     cfg, sched, dynamic_lists = parts.cfg, parts.sched, parts.dynamic_lists
+    # owner half of the cross-host trace join: forwarded-line bans
+    # resolve (origin_node, origin_trace) through the fleet index —
+    # inert until a propagating sender actually feeds it
+    provenance.set_origin_resolver(fleet.get_origin_index().resolve)
+    if args.trace_propagation:
+        # origin half: router-allocated trace ids need a live span ring
+        trace.configure(enabled=True)
     if replicator is not None:
         replicator.configure(cfg)
         # the origin's own kafka echo is suppressed by the deduper, so
@@ -230,9 +244,47 @@ def main(argv=None) -> int:
     shutdown = threading.Event()
     state = {"router": None, "membership": None}
 
-    def _local_submit(lines) -> int:
-        sched.submit(list(lines))
+    def _local_submit(lines, t_read=None, hop="local") -> int:
+        sched.submit(list(lines), t_read=t_read, hop=hop)
         return len(lines)
+
+    def _metrics_text() -> str:
+        return render_prometheus(
+            dynamic_lists, {}, {}, matcher=parts.matcher,
+            pipeline=sched, fabric=fstats,
+        )
+
+    def _health_bits() -> int:
+        return fleet.compute_health_bits(matcher=parts.matcher)
+
+    def _drain_forwarded(lines, origin_node="", origin_runs=(),
+                         origin_t_read=None):
+        """Owner-side drain of a forwarded chunk (mirrors
+        fabric/service.py): feed the OriginIndex, open linked
+        fabric.remote-drain spans under the ORIGIN trace ids, stamp the
+        submit hop=fabric with the sender's read time."""
+        spans = []
+        if origin_node:
+            runs = [(int(t), int(c)) for t, c in (origin_runs or ())]
+            if not runs:
+                runs = [(0, len(lines))]
+            idx = fleet.get_origin_index()
+            pos = 0
+            for tid, count in runs:
+                for ln in lines[pos:pos + count]:
+                    idx.note(ip_of_line(ln), origin_node, tid)
+                if tid:
+                    spans.append(trace.begin(
+                        "fabric.remote-drain", tid,
+                        args={"origin_node": origin_node, "lines": count},
+                    ))
+                pos += count
+        try:
+            t_read = float(origin_t_read) if origin_t_read else None
+            _local_submit(lines, t_read=t_read, hop="fabric")
+        finally:
+            for sp in spans:
+                trace.end(sp)
 
     def _make_client(pid, host, port, timeout_ms=None):
         return PeerClient(
@@ -255,6 +307,9 @@ def main(argv=None) -> int:
         timeout_ms = float(
             payload.get("send_timeout_ms", args.send_timeout_ms)
         )
+        trace_prop = bool(
+            payload.get("trace_propagation", args.trace_propagation)
+        )
 
         def factory(pid, host, port, on_ack):
             return LinePipe(
@@ -264,6 +319,7 @@ def main(argv=None) -> int:
                 frame_max_bytes=frame_max,
                 wire_v2=v2, shm=shm, shm_ring_bytes=ring_bytes,
                 stats=fstats, on_ack=on_ack,
+                trace_propagation=trace_prop,
             )
         return factory
 
@@ -276,6 +332,7 @@ def main(argv=None) -> int:
             suspect_timeout_ms=suspect_ms,
             indirect_probes=indirect,
             peer_factory=_make_client,
+            health_provider=_health_bits,
         )
         if seeds:
             ms.seed(seeds)
@@ -305,6 +362,9 @@ def main(argv=None) -> int:
                 payload.get("grace_ms", args.grace_ms)
             ),
             pipe_factory=_pipe_factory_from(payload),
+            trace_propagation=bool(payload.get(
+                "trace_propagation", args.trace_propagation
+            )),
         )
         state["router"] = router
         gossip_ms = float(
@@ -344,7 +404,14 @@ def main(argv=None) -> int:
                 # dedupe filter would (rightly) refuse to re-run them
                 router.flush(15.0)
             return wire.T_ACK, {"n": len(lines), **out, **piggy}
-        _local_submit(lines)
+        origin = payload.get("origin")
+        origin = origin if isinstance(origin, dict) else {}
+        _drain_forwarded(
+            lines,
+            str(origin.get("node", "")),
+            origin.get("runs") or (),
+            origin.get("t_read"),
+        )
         fstats.note_local(len(lines))
         return wire.T_ACK, {
             "n": len(lines), "local": len(lines), **piggy
@@ -356,7 +423,9 @@ def main(argv=None) -> int:
         # straight down the local pipeline
         lines = list(fr.lines)
         fstats.note_received(len(lines))
-        _local_submit(lines)
+        _drain_forwarded(
+            lines, fr.origin_node, fr.origin_runs, fr.origin_t_read
+        )
         fstats.note_local(len(lines))
         ms = state["membership"]
         ack = {"seq": fr.seq, "n": len(lines), "local": len(lines)}
@@ -474,7 +543,7 @@ def main(argv=None) -> int:
     def h_stats(payload):
         router = state["router"]
         ms = state["membership"]
-        return wire.T_STATS_R, {
+        out = {
             "node_id": node_id,
             "sched": sched.stats.peek(),
             "fabric": fstats.peek(),
@@ -484,6 +553,42 @@ def main(argv=None) -> int:
             "router": router.describe() if router is not None else None,
             "membership": ms.describe() if ms is not None else None,
             "detection": fstats.detection_snapshot()[1],
+        }
+        if payload.get("metrics"):
+            # federated scrape pull (obs/fleet.py FleetScraper)
+            try:
+                out["metrics_text"] = _metrics_text()
+            except Exception as e:  # noqa: BLE001 — a render bug must not kill the link
+                out["metrics_error"] = str(e)
+        return wire.T_STATS_R, out
+
+    def h_explain(payload):
+        # cross-shard /decisions/explain: answer from THIS shard's
+        # ledger, tagged with our id so the asker can attribute it
+        ip = str(payload.get("ip", ""))
+        ed = dynamic_lists.format_ip_entries().get(ip)
+        return wire.T_EXPLAIN_R, {
+            "node_id": node_id,
+            "ip": ip,
+            "ledger_enabled": provenance.enabled(),
+            "records": provenance.get_ledger().explain(ip),
+            "active_decision": ed.decision.name if ed is not None else None,
+        }
+
+    def h_flightrec(payload):
+        # a peer's incident fan-out: contribute THIS node's snapshot
+        # (never re-fan-out — the origin owns the incident)
+        router = state["router"]
+        return wire.T_FLIGHTREC_R, {
+            "node_id": node_id,
+            "incident": str(payload.get("incident", "")),
+            "files": fleet.local_capture_files(
+                metrics_text_fn=_metrics_text,
+                fabric_fn=(
+                    router.describe if router is not None
+                    else lambda: {"enabled": False}
+                ),
+            ),
         }
 
     def h_snapshot(payload):
@@ -532,6 +637,8 @@ def main(argv=None) -> int:
             wire.T_LEAVE: h_leave,
             wire.T_FAILPOINT: h_failpoint,
             wire.T_STATS: h_stats,
+            wire.T_EXPLAIN: h_explain,
+            wire.T_FLIGHTREC: h_flightrec,
             wire.T_SNAPSHOT: h_snapshot,
             wire.T_SYNC: h_sync,
             wire.T_FLUSH: h_flush,
@@ -577,6 +684,7 @@ def main(argv=None) -> int:
                 clients, _local_submit, stats=fstats, health=health,
                 takeover_grace_ms=args.grace_ms,
                 pipe_factory=_pipe_factory_from({}),
+                trace_propagation=bool(args.trace_propagation),
             )
             state["router"] = router
             ms = _start_membership(

@@ -108,6 +108,13 @@ class ServerDeps:
     # decision-fabric counters (fabric/stats.py FabricStats) — None when
     # the fabric is off
     fabric_getter: Optional[Callable[[], object]] = None
+    # fleet observability (obs/fleet.py FleetScraper) — None unless
+    # fleet_metrics_enabled AND the fabric is on; /metrics?fleet=1
+    fleet_getter: Optional[Callable[[], object]] = None
+    # the FabricService itself (fabric/service.py) — the cross-shard
+    # /decisions/explain proxy needs owner_of + explain_remote, which
+    # live on the service, not on its stats object
+    fabric_service_getter: Optional[Callable[[], object]] = None
     # device-batched PoW verifier (challenge/verifier.py DeviceVerifier)
     # — None = pure-CPU reference verification, decisions identical
     challenge_verifier: Optional[object] = None
@@ -504,6 +511,26 @@ def build_app(deps: ServerDeps,
         denied = _admin_denied(request)
         if denied is not None:
             return denied
+        if request.query.get("fleet") in ("1", "true"):
+            scraper = deps.fleet_getter() if deps.fleet_getter else None
+            if scraper is None:
+                return web.json_response(
+                    {"error": "fleet metrics disabled "
+                              "(fleet_metrics_enabled + fabric required)"},
+                    status=404,
+                )
+            # scrape() does blocking peer socket I/O — keep it off the
+            # event loop; peer failures degrade inside scrape() (cached/
+            # unreachable gauges), so this is partial-but-200, never a 500
+            text = await asyncio.get_running_loop().run_in_executor(
+                None, scraper.scrape
+            )
+            return web.Response(
+                text=text,
+                content_type="text/plain",
+                charset="utf-8",
+                headers={"X-Prometheus-Exposition-Version": "0.0.4"},
+            )
         from banjax_tpu.obs.exposition import render_prometheus
 
         text = render_prometheus(
@@ -559,6 +586,32 @@ def build_app(deps: ServerDeps,
             )
         from banjax_tpu.obs import provenance as provenance_mod
 
+        # cross-shard proxy: when the fabric is on and this IP hashes to
+        # another owner, the authoritative ledger lives THERE — forward
+        # the question over the peer wire (T_EXPLAIN) and tag the answer
+        # with the owning node.  Unreachable owner -> fall back to the
+        # local (partial) view, flagged, never a 500.
+        owner_unreachable = None
+        svc = (
+            deps.fabric_service_getter()
+            if deps.fabric_service_getter else None
+        )
+        if svc is not None:
+            try:
+                owner = svc.router.owner_of(ip)
+            except Exception:
+                owner = None
+            if owner is not None and owner != svc.node_id:
+                try:
+                    payload = await asyncio.get_running_loop().run_in_executor(
+                        None, svc.explain_remote, owner, ip
+                    )
+                    payload["owning_node"] = owner
+                    payload["proxied"] = True
+                    return web.json_response(payload)
+                except Exception:
+                    owner_unreachable = owner
+
         ledger = provenance_mod.get_ledger()
         records = ledger.explain(ip)
         active = None
@@ -572,12 +625,17 @@ def build_app(deps: ServerDeps,
                     "domain": ed.domain,
                     "from_baskerville": ed.from_baskerville,
                 }
-        return web.json_response({
+        out = {
             "ip": ip,
             "ledger_enabled": ledger.enabled,
             "records": records,
             "active_decision": active,
-        })
+        }
+        if svc is not None:
+            out["node_id"] = svc.node_id
+        if owner_unreachable is not None:
+            out["owner_unreachable"] = owner_unreachable
+        return web.json_response(out)
 
     async def traffic_top_route(request: web.Request) -> web.Response:
         """Live traffic introspection (obs/sketch.py): top-K heavy

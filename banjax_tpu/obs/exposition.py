@@ -392,6 +392,12 @@ def render_prometheus(
             registry.PROM_FAMILIES["banjax_fabric_ack_rtt_seconds"],
             fabric.ack_rtt,
         )
+        # gossip-piggybacked fleet health bits (obs/fleet.py encoding)
+        peer_health = fabric.peer_health_snapshot()
+        if peer_health:
+            fam = registry.PROM_FAMILIES["banjax_fabric_peer_health"]
+            for nid, bits in sorted(peer_health.items()):
+                w.sample(fam, bits, {"node": nid})
 
     # component health: aggregate + one labeled gauge per component
     if health is not None:
@@ -417,6 +423,10 @@ def render_prometheus(
         stage_fam = registry.PROM_FAMILIES["banjax_stage_duration_seconds"]
         for stage, hist in pipeline.stats.stage_hists.items():
             w.histogram(stage_fam, hist, {"stage": stage})
+        # tailer read -> effector commit, by hop (local vs fabric)
+        e2e_fam = registry.PROM_FAMILIES["banjax_e2e_latency_seconds"]
+        for hop, hist in pipeline.stats.e2e_hists.items():
+            w.histogram(e2e_fam, hist, {"hop": hop})
     return w.text()
 
 

@@ -69,6 +69,9 @@ class FlightRecorder:
         slo_getter: Optional[Callable[[], object]] = None,
         traffic_fn: Optional[Callable[[], Optional[dict]]] = None,
         fabric_fn: Optional[Callable[[], Optional[dict]]] = None,
+        fleet_capture_fn: Optional[
+            Callable[[str], "dict[str, dict[str, str]]"]
+        ] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         self.directory = directory
@@ -81,6 +84,7 @@ class FlightRecorder:
         self._slo_getter = slo_getter
         self._traffic_fn = traffic_fn
         self._fabric_fn = fabric_fn
+        self._fleet_capture_fn = fleet_capture_fn
         self._clock = clock
         self._lock = threading.Lock()
         self._last_capture = float("-inf")
@@ -162,6 +166,30 @@ class FlightRecorder:
             },
             indent=1,
         )
+        # cluster incident capture (obs/fleet.py capture_fleet): every
+        # ALIVE peer contributes its own trace/metrics/provenance/fabric
+        # snapshot under peers/<node_id>/ — a cross-shard episode reads
+        # as ONE bundle instead of N /debug/incidents to correlate.
+        # Fan-out happens before meta.json so the manifest lists the
+        # peer tree; a peer that cannot answer appears as error.txt.
+        peer_files: dict = {}
+        if self._fleet_capture_fn is not None:
+            try:
+                raw = self._fleet_capture_fn(name) or {}
+            except Exception:  # noqa: BLE001 — fleet capture must not sink the bundle
+                raw = {}
+            for nid, pf in raw.items():
+                nid_s = os.path.basename(str(nid))
+                if not nid_s or nid_s.startswith("."):
+                    continue
+                clean = {}
+                for fname, content in (pf or {}).items():
+                    fname_s = os.path.basename(str(fname))
+                    if fname_s and not fname_s.startswith("."):
+                        clean[fname_s] = str(content)
+                if clean:
+                    peer_files[nid_s] = clean
+
         slo = self._slo_getter() if self._slo_getter else None
         meta = {
             "reason": reason,
@@ -173,13 +201,24 @@ class FlightRecorder:
             ),
             "health": self._health.snapshot() if self._health else None,
             "slo": slo.snapshot() if slo is not None else None,
-            "files": sorted(files) + ["meta.json"],
+            "files": sorted(files) + ["meta.json"] + sorted(
+                f"peers/{nid}/{fname}"
+                for nid, pf in peer_files.items() for fname in pf
+            ),
         }
         files["meta.json"] = json.dumps(meta, indent=1)
 
         for fname, content in files.items():
             with open(os.path.join(tmp, fname), "w", encoding="utf-8") as f:
                 f.write(content)
+        for nid, pf in peer_files.items():
+            pdir = os.path.join(tmp, "peers", nid)
+            os.makedirs(pdir, exist_ok=True)
+            for fname, content in pf.items():
+                with open(
+                    os.path.join(pdir, fname), "w", encoding="utf-8"
+                ) as f:
+                    f.write(content)
         os.rename(tmp, final)  # atomic publish: listed == complete
         with self._lock:
             self.incident_count += 1
@@ -244,11 +283,20 @@ class FlightRecorder:
 
     def read_file(self, name: str, fname: str) -> Optional[bytes]:
         """One bundle file's bytes; None when absent.  Both components
-        are validated against directory listings — no path traversal."""
+        are validated — no path traversal.  ``fname`` may be a top-level
+        bundle file or a fleet capture path ``peers/<node_id>/<file>``
+        (exactly three components, each a clean basename)."""
         if name != os.path.basename(name) or not name.startswith("incident-"):
             return None
-        if fname != os.path.basename(fname):
+        parts = fname.split("/")
+        if len(parts) == 3 and parts[0] == "peers":
+            parts = parts[1:]
+        elif len(parts) != 1:
             return None
+        for part in parts:
+            if (not part or part != os.path.basename(part)
+                    or part in (".", "..") or part.startswith(".")):
+                return None
         path = os.path.join(self.directory, name, fname)
         try:
             with open(path, "rb") as f:

@@ -31,14 +31,25 @@ Record fields (fixed tuple, one per insertion):
     ip, decision (string form), source, rule name, rule index,
     window hit count at fire time, trace id of the admitting batch
     (from the ambient span when the insert happens on a traced drain
-    thread), monotonic timestamp, wall timestamp.
+    thread), monotonic timestamp, wall timestamp, origin node id,
+    origin trace id.
+
+The last two fields are the fleet join (PR 20): when the banned line
+was tailed on ANOTHER node and forwarded here by the fabric, the
+installed origin resolver (obs/fleet.py OriginIndex, fed by the
+owner-side chunk handlers) maps the IP back to the forwarding node and
+the trace id its router allocated at admission — so
+``/decisions/explain`` on the owner shard answers with the origin
+batch's trace id, joinable against the origin node's /debug/trace
+ring.  Locally-tailed bans leave them empty ("" / 0) and the explain
+payload omits the keys entirely.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from banjax_tpu.obs import trace
 
@@ -105,8 +116,18 @@ class ProvenanceLedger:
         if trace_id is None:
             trace_id = trace.current_trace_id()
         decision_s = str(decision)
+        origin_node, origin_trace = "", 0
+        resolver = _origin_resolver
+        if resolver is not None:
+            try:
+                origin = resolver(ip)
+                if origin:
+                    origin_node, origin_trace = str(origin[0]), int(origin[1])
+            except Exception:  # resolution must never break a record path
+                pass
         rec = (ip, decision_s, source, rule, int(rule_index), hits,
-               int(trace_id), time.monotonic(), time.time())
+               int(trace_id), time.monotonic(), time.time(),
+               origin_node, origin_trace)
         lock = self._locks[source]
         with lock:
             n = self._ns[source]
@@ -132,8 +153,9 @@ class ProvenanceLedger:
 
     @staticmethod
     def _to_dict(rec: tuple) -> dict:
-        ip, decision, source, rule, rule_index, hits, tid, t_mono, t_wall = rec
-        return {
+        (ip, decision, source, rule, rule_index, hits, tid, t_mono,
+         t_wall, origin_node, origin_trace) = rec
+        out = {
             "ip": ip,
             "decision": decision,
             "source": source,
@@ -144,6 +166,10 @@ class ProvenanceLedger:
             "t_monotonic": round(t_mono, 6),
             "time_unix": round(t_wall, 6),
         }
+        if origin_node:
+            out["origin_node"] = origin_node
+            out["origin_trace_id"] = origin_trace
+        return out
 
     def explain(self, ip: str) -> List[dict]:
         """Full ledger history for one IP across every source, oldest
@@ -175,6 +201,19 @@ class ProvenanceLedger:
 # ---- process-wide ledger ---------------------------------------------------
 
 _ledger = ProvenanceLedger(enabled=True)
+
+# ip -> (origin_node_id, origin_trace_id) | None: installed by the
+# fabric wiring (obs/fleet.py OriginIndex.resolve) so forwarded-line
+# bans carry their cross-host admission attribution; survives a
+# configure() ledger swap
+_origin_resolver: Optional[Callable[[str], Optional[Tuple[str, int]]]] = None
+
+
+def set_origin_resolver(
+    fn: Optional[Callable[[str], Optional[Tuple[str, int]]]],
+) -> None:
+    global _origin_resolver
+    _origin_resolver = fn
 
 
 def get_ledger() -> ProvenanceLedger:

@@ -545,6 +545,23 @@ FAMILIES: List[Family] = [
            line_key="IpsetQueueShed", prom="banjax_ipset_queue_shed_total"),
     Family(GAUGE, "bans waiting in the netlink batch queue",
            prom="banjax_ipset_queue_depth"),
+    # ---- fleet observability plane (obs/fleet.py) ----
+    Family(GAUGE, "gossip-piggybacked health bits of the labeled fleet "
+           "node (bit 1 slo_breached, bit 2 breaker open, bit 4 breaker "
+           "half-open; 0 = healthy)",
+           prom="banjax_fabric_peer_health", labels=("node",)),
+    Family(GAUGE, "1 when the labeled peer could not be reached by the "
+           "last /metrics?fleet=1 fan-out (its samples come from the "
+           "stale cache or are absent — partial-but-honest view)",
+           prom="banjax_fleet_peer_unreachable", labels=("instance",)),
+    Family(GAUGE, "age (s) of the labeled peer's snapshot in the merged "
+           "fleet exposition (near zero for a live pull)",
+           prom="banjax_fleet_peer_staleness_seconds",
+           labels=("instance",)),
+    Family(HISTOGRAM, "tailer read -> effector commit end-to-end latency "
+           "(s), by hop (local = owned by the tailing node, fabric = "
+           "forwarded to its owner over the wire)",
+           prom="banjax_e2e_latency_seconds", labels=("hop",)),
     # ---- histograms (prom-only) ----
     Family(HISTOGRAM, "device verification batch size (candidate "
            "solutions per sha256 kernel dispatch)",

@@ -91,6 +91,7 @@ class SloEngine:
         on_breach: Optional[Callable[[str, dict], None]] = None,
         clock: Callable[[], float] = time.monotonic,
         max_samples: int = 512,
+        collect_fn: Optional[Callable[[], Dict[str, float]]] = None,
     ):
         if not 0.0 < batch_latency_target < 1.0:
             raise ValueError(
@@ -114,6 +115,7 @@ class SloEngine:
         self.breaker_open_ratio_max = breaker_open_ratio_max
         self.budget_trip_ratio_max = budget_trip_ratio_max
         self._on_breach = on_breach
+        self._collect_fn = collect_fn
         self._clock = clock
         self._lock = threading.Lock()
         self._samples: deque = deque(maxlen=max(8, int(max_samples)))
@@ -147,6 +149,17 @@ class SloEngine:
     # ---- collection (non-destructive reads only) ----
 
     def _collect(self) -> Dict[str, float]:
+        # fleet mode (obs/fleet.py FleetScraper.fleet_collect): the
+        # injected collector replaces the local getters entirely — the
+        # engine burns over CLUSTER counter sums with identical window
+        # mechanics, so fleet and node SLOs stay comparable
+        if self._collect_fn is not None:
+            try:
+                return {
+                    k: float(v) for k, v in (self._collect_fn() or {}).items()
+                }
+            except Exception:  # noqa: BLE001 — a collector bug must not stop sampling
+                return {}
         vals: Dict[str, float] = {}
         matcher = self._matcher_getter() if self._matcher_getter else None
         if matcher is not None:

@@ -350,6 +350,9 @@ class PipelineStats:
         # fixed-bucket distributions for /metrics (obs/registry.py)
         self.device_latency_hist = Histogram()
         self.stage_hists = StageHistograms()
+        # tailer read -> effector commit, keyed by hop (local lines vs
+        # fabric-forwarded ones) — banjax_e2e_latency_seconds{hop}
+        self.e2e_hists = StageHistograms()
 
     def note_admitted(self, n: int) -> None:
         with self._lock:
@@ -452,6 +455,12 @@ class PipelineStats:
         banjax_stage_duration_seconds histogram (scheduler drain loop)."""
         for stage, ms in stage_ms.items():
             self.stage_hists.observe(stage, ms / 1e3)
+
+    def observe_e2e(self, hop: str, seconds: float) -> None:
+        """One batch's oldest tailer-read stamp -> effector commit
+        (banjax_e2e_latency_seconds{hop}); recorded at drain completion
+        by the scheduler when the batch carried any read stamp."""
+        self.e2e_hists.observe(hop, max(0.0, seconds))
 
     def device_p99_s(self) -> Optional[float]:
         with self._lock:

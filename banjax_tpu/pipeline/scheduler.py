@@ -115,7 +115,7 @@ def resolve_encode_workers(v: int) -> int:
 
 class _Batch:
     __slots__ = ("lines", "matcher", "state", "t_encode_ms", "t_device_ms",
-                 "t0_device", "kind", "trace_id", "root_span")
+                 "t0_device", "kind", "trace_id", "root_span", "e2e")
 
     def __init__(self, lines: List[str], kind: str = "lines"):
         self.lines = lines      # log lines, or _Command items (kind="cmd")
@@ -131,6 +131,9 @@ class _Batch:
         # tracing is off — every span call below no-ops on them)
         self.trace_id = 0
         self.root_span = trace.NOOP_SPAN
+        # {hop: oldest tailer-read monotonic stamp} for the lines this
+        # batch took — observed into banjax_e2e_latency_seconds at drain
+        self.e2e: dict = {}
 
 
 class _Command:
@@ -189,6 +192,10 @@ class PipelineScheduler:
         )
         self.stats = PipelineStats()
         self._buf: deque = deque()
+        # read-stamp runs parallel to the LINE items in _buf: [count,
+        # t_read, hop] per admitted chunk, trimmed in lockstep by sheds
+        # and encode takes (commands carry no stamp and no mark)
+        self._marks: deque = deque()
         self._cond = threading.Condition()
         self._inflight = 0
         self._last_activity = time.monotonic()
@@ -272,12 +279,16 @@ class PipelineScheduler:
 
     # ---- admission (tailer thread) ----
 
-    def submit(self, lines: Sequence[str]) -> None:
+    def submit(self, lines: Sequence[str], t_read: Optional[float] = None,
+               hop: str = "local") -> None:
         """Admit a chunk of log lines.  Blocks for at most
         `pipeline_max_block_ms` when the buffer is full, then sheds
         oldest-first — the tailer is never blocked unboundedly and memory
-        is never unbounded."""
-        self._admit(list(lines))
+        is never unbounded.  `t_read` is the tailer-read monotonic stamp
+        and `hop` whether the chunk was tailed here ("local") or arrived
+        over the fabric wire ("fabric") — together they feed the
+        banjax_e2e_latency_seconds{hop} histogram at drain time."""
+        self._admit(list(lines), t_read=t_read, hop=hop)
 
     def submit_commands(
         self, raws: Sequence[bytes], handler: Callable[[bytes], None]
@@ -287,9 +298,36 @@ class PipelineScheduler:
         (admitted == processed + shed holds across both producers), and
         the drain stage dispatches `handler(raw)` per message in admission
         order relative to everything else in the stream."""
-        self._admit([_Command(r, handler) for r in raws])
+        self._admit([_Command(r, handler) for r in raws], hop=None)
 
-    def _admit(self, lines: list) -> None:
+    def _mark_drop_locked(self) -> None:
+        """One LINE item left the buffer head: trim the oldest mark."""
+        if not self._marks:
+            return
+        m = self._marks[0]
+        m[0] -= 1
+        if m[0] <= 0:
+            self._marks.popleft()
+
+    def _take_marks_locked(self, n: int) -> dict:
+        """Consume marks for `n` line items taken off the buffer head;
+        returns {hop: oldest t_read} over the stamped ones."""
+        out: dict = {}
+        while n > 0 and self._marks:
+            m = self._marks[0]
+            took = min(n, m[0])
+            if m[1] is not None:
+                hop = m[2]
+                if hop not in out or m[1] < out[hop]:
+                    out[hop] = m[1]
+            m[0] -= took
+            n -= took
+            if m[0] <= 0:
+                self._marks.popleft()
+        return out
+
+    def _admit(self, lines: list, t_read: Optional[float] = None,
+               hop: Optional[str] = "local") -> None:
         if not lines:
             return
         self.stats.note_admitted(len(lines))
@@ -312,7 +350,9 @@ class PipelineScheduler:
                 # sustained overload: oldest-first shed, every line counted
                 dropped = 0
                 while overflow > 0 and self._buf:
-                    self._buf.popleft()
+                    item = self._buf.popleft()
+                    if isinstance(item, str):
+                        self._mark_drop_locked()
                     overflow -= 1
                     dropped += 1
                 if overflow > 0:  # chunk alone exceeds the buffer bound
@@ -328,6 +368,8 @@ class PipelineScheduler:
                 shed_burst = dropped
             was_empty = not self._buf
             self._buf.extend(lines)
+            if hop is not None and lines:
+                self._marks.append([len(lines), t_read, hop])
             if was_empty:
                 # the encode thread only sleeps on an empty buffer; waking
                 # it per chunk would burn the tailer thread on notify calls
@@ -382,6 +424,10 @@ class PipelineScheduler:
                         and isinstance(self._buf[0], _Command) == is_cmd
                     ):
                         lines.append(self._buf.popleft())
+                    e2e = (
+                        self._take_marks_locked(len(lines))
+                        if lines and not is_cmd else {}
+                    )
                     if lines:
                         self._inflight += 1
                     self._cond.notify_all()
@@ -392,6 +438,7 @@ class PipelineScheduler:
                 # trace id here so admission-buffer wait is excluded but
                 # every stage (incl. queueing between stages) is covered
                 batch = _Batch(lines, kind="cmd" if is_cmd else "lines")
+                batch.e2e = e2e
                 if trace.enabled():
                     batch.trace_id = trace.new_trace()
                     batch.root_span = trace.begin(
@@ -704,6 +751,13 @@ class PipelineScheduler:
                         self._health.degraded("drain failure; lines shed")
             if ok:
                 self.stats.note_processed(n)
+                if batch.e2e:
+                    # effector commit time for every line in the batch:
+                    # drain completion, measured against the oldest
+                    # tailer-read stamp per hop
+                    now_mono = time.monotonic()
+                    for hop, t0 in batch.e2e.items():
+                        self.stats.observe_e2e(hop, now_mono - t0)
                 if self._health is not None:
                     self._health.ok()
             else:

@@ -69,6 +69,15 @@ Instrumented sites in this tree (KNOWN_SITES):
                      sendmsg (effectors/ipset_netlink.py): an injected
                      fault routes the whole batch to the per-entry
                      subprocess fallback — no ban is lost
+  obs.fleet.pull   — federated metrics fan-out, before each per-peer
+                     T_STATS pull (obs/fleet.py FleetScraper): an
+                     injected fault degrades that peer to its cached
+                     snapshot (flagged stale) or drops it (flagged
+                     unreachable) — /metrics?fleet=1 stays a 200
+  obs.fleet.capture — cluster incident fan-out, before each per-peer
+                     T_FLIGHTREC exchange (obs/fleet.py capture_fleet):
+                     an injected fault turns that peer's bundle tree
+                     into an error.txt — the local capture still lands
 """
 
 from __future__ import annotations
@@ -110,6 +119,8 @@ KNOWN_SITES = (
     "challenge.device_verify",
     "serve.fastpath.lookup",
     "ipset.netlink.send",
+    "obs.fleet.pull",
+    "obs.fleet.capture",
 )
 
 MODES = ("error", "sleep")

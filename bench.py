@@ -110,6 +110,15 @@ the takeover-window shed ratio into BENCH_fabric.json.  Every row is
 recall-gated at 1.0 vs the oracle.  Knobs:
 BENCH_FABRIC_{SHAPE,SEED,SCALE,NS}.
 
+Fleet-obs mode: `bench.py --fleet-obs` — fleet observability overhead
+on the N=2 fabric feed: off → on → off where "on" arms origin trace
+propagation on every forwarded frame plus the worker fleet surfaces
+(T_EXPLAIN / T_FLIGHTREC / T_STATS metrics).  The on-arm ban log is
+byte-compared against off, and the banked row carries a live-plane
+witness: a forwarded-line ban whose explain provenance joins the
+origin trace id allocated at the tailing shard's admission.  Banked
+into BENCH_fleet_obs.json.  Knobs: BENCH_FABRIC_{SHAPE,SEED,SCALE}.
+
 Challenge mode: `bench.py --challenge` — the challenge plane
 (banjax_tpu/challenge/): (a) PoW cookie verification throughput
 (cookies/s) as a CPU-reference vs device-batched A/B over the same
@@ -2146,6 +2155,199 @@ def _fabric_mode() -> None:
     print(json.dumps({"metric": book["metric"], **book["summary"]}))
 
 
+FLEET_OBS_PATH = os.path.join(_DIR, "BENCH_fleet_obs.json")
+
+
+def _fleet_obs_witness(tmp_dir: str) -> dict:
+    """Non-vacuity witness for the fleet-obs rows: two real workers with
+    trace propagation armed, a probe flood tailed at w0 whose IP hashes
+    to w1, and the resulting ban's provenance on w1 joined back to w0's
+    `fabric.route` admission span by origin trace id.  Returns the
+    joined evidence; raises if the join never happens — an idle
+    observability plane must not bank a vacuous "no overhead"."""
+    from banjax_tpu.fabric import wire as fwire
+    from banjax_tpu.fabric.harness import _fake_broker, _spawn
+    from banjax_tpu.fabric.hashring import ConsistentHashRing
+    from banjax_tpu.scenarios.shapes import T0
+
+    ring = ConsistentHashRing(("w0", "w1"), vnodes=64)
+    i = 0
+    while True:
+        ip = f"10.{(i >> 8) & 255}.{i & 255}.7"
+        if ring.owner(ip) == "w1":
+            break
+        i += 1
+
+    broker = _fake_broker()
+    broker.start()
+    workers = {}
+    try:
+        for wid in ("w0", "w1"):
+            workers[wid] = _spawn(
+                wid, broker.port, os.path.join(tmp_dir, f"{wid}.err"),
+                extra_args=("--trace-propagation", "1"),
+            )
+        for w in workers.values():
+            w.read_ready(420.0)
+        hello = {
+            "peers": {
+                w.wid: ["127.0.0.1", w.port] for w in workers.values()
+            },
+            "vnodes": 64, "send_timeout_ms": 2000.0, "grace_ms": 200.0,
+            "inflight_frames": 8, "wire_v2": True, "shm": False,
+            "trace_propagation": True,
+        }
+        for w in workers.values():
+            w.request(fwire.T_HELLO, hello)
+        lines = [
+            f"{T0 + j * 0.1:.6f} {ip} GET example.com GET "
+            "/wp-login.php HTTP/1.1 scanner -"
+            for j in range(20)
+        ]
+        workers["w0"].request(fwire.T_LINES, {"lines": lines, "route": True})
+        for w in workers.values():
+            w.request(fwire.T_FLUSH, {"timeout": 600})
+        explain = {}
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            explain = workers["w1"].request(fwire.T_EXPLAIN, {"ip": ip})
+            if explain.get("records"):
+                break
+            time.sleep(0.25)
+        recs = [
+            r for r in explain.get("records", ())
+            if r.get("origin_node") == "w0"
+        ]
+        assert recs, f"no forwarded-line ban recorded for {ip}: {explain}"
+        origin_tid = recs[0]["origin_trace_id"]
+        assert origin_tid > 0, recs[0]
+        cap = workers["w0"].request(
+            fwire.T_FLIGHTREC, {"incident": "bench-witness", "from": "b"}
+        )
+        route_tids = {
+            e["args"]["trace_id"]
+            for e in json.loads(cap["files"]["trace.json"])["traceEvents"]
+            if e["name"] == "fabric.route"
+        }
+        assert origin_tid in route_tids, (origin_tid, route_tids)
+        return {
+            "banned_ip": ip,
+            "origin_node": recs[0]["origin_node"],
+            "origin_trace_id": origin_tid,
+            "explain_joins_origin_trace": True,
+            "decision": recs[0].get("decision"),
+        }
+    finally:
+        for w in workers.values():
+            try:
+                w.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                w.proc.kill()
+        broker.stop()
+
+
+def _fleet_obs_mode() -> None:
+    """`bench.py --fleet-obs`: fleet observability overhead on the N=2
+    fabric feed — the same off → on → off bracketing protocol as the
+    other obs A/Bs, where "on" arms origin trace propagation on every
+    forwarded frame plus the worker-side fleet surfaces
+    (T_EXPLAIN / T_FLIGHTREC / T_STATS metrics).  Decisions must not
+    change: the on-arm ban log is byte-compared against the off arm.
+    The banked witness row proves the plane was live — a forwarded-line
+    ban on w1 whose /decisions/explain provenance joins the origin
+    trace id allocated at w0's admission.  Banked into
+    BENCH_fleet_obs.json.  Knobs: BENCH_FABRIC_{SHAPE,SEED,SCALE}."""
+    import tempfile
+
+    from banjax_tpu.fabric.harness import run_fabric
+
+    shape = os.environ.get("BENCH_FABRIC_SHAPE", "flash_crowd")
+    seed = int(os.environ.get("BENCH_FABRIC_SEED", "20260804"))
+    scale = float(os.environ.get("BENCH_FABRIC_SCALE", "1.0"))
+
+    def run_arm(fleet_obs: bool) -> dict:
+        report = run_fabric(
+            n_workers=2, shape=shape, seed=seed, scale=scale,
+            kill=False, fleet_obs=fleet_obs,
+        )
+        bad = [k for k, ok in report["invariants"].items() if not ok]
+        assert not bad, f"fleet-obs arm invariants failed: {bad}"
+        return report
+
+    def row(report: dict, fleet_obs: bool) -> dict:
+        return {
+            "fleet_obs": fleet_obs,
+            "lines_per_sec": report["lines_per_sec"],
+            "lines": report["n_lines"],
+            "feed_s": report["feed_s"],
+            "engine_bans": report["engine_bans"],
+            "oracle_bans": report["oracle_bans"],
+            "precision": report["precision"],
+            "recall": report["recall"],
+        }
+
+    def ban_log_bytes(report: dict) -> bytes:
+        return ("\n".join(report["ban_log"]) + "\n").encode()
+
+    off_a_rep = run_arm(False)
+    on_rep = run_arm(True)
+    off_b_rep = run_arm(False)
+    assert ban_log_bytes(on_rep) == ban_log_bytes(off_a_rep), (
+        "fleet-obs changed the ban log"
+    )
+    off_a, on, off_b = (
+        row(off_a_rep, False), row(on_rep, True), row(off_b_rep, False)
+    )
+    off = max(off_a, off_b, key=lambda r: r["lines_per_sec"])
+    noise_band_pct = round(
+        abs(off_a["lines_per_sec"] - off_b["lines_per_sec"])
+        / max(off_a["lines_per_sec"], off_b["lines_per_sec"]) * 100.0, 2
+    )
+    overhead_pct = round(
+        (off["lines_per_sec"] - on["lines_per_sec"])
+        / off["lines_per_sec"] * 100.0, 2
+    )
+
+    with tempfile.TemporaryDirectory() as td:
+        witness = _fleet_obs_witness(td)
+
+    book = {
+        "metric": (
+            "N=2 fabric feed lines/s, fleet observability off vs on "
+            "(origin trace propagation + fleet surfaces)"
+        ),
+        "shape": shape,
+        "seed": seed,
+        "scale": scale,
+        "measured_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "off": off,
+        "on": on,
+        "off_runs": [off_a["lines_per_sec"], off_b["lines_per_sec"]],
+        "on_vs_off_overhead_pct": overhead_pct,
+        "off_run_noise_band_pct": noise_band_pct,
+        "on_within_off_noise_band": bool(
+            overhead_pct <= max(noise_band_pct, 1.0)
+        ),
+        "ban_log_byte_identical": True,
+        "witness": witness,
+    }
+    tmp = FLEET_OBS_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(book, f, indent=1)
+    os.replace(tmp, FLEET_OBS_PATH)
+    print(json.dumps({
+        "metric": book["metric"],
+        "off_lines_per_sec": off["lines_per_sec"],
+        "on_lines_per_sec": on["lines_per_sec"],
+        "on_vs_off_overhead_pct": overhead_pct,
+        "off_run_noise_band_pct": noise_band_pct,
+        "on_within_off_noise_band": book["on_within_off_noise_band"],
+        "witness": witness,
+    }))
+
+
 CHALLENGE_PATH = os.path.join(_DIR, "BENCH_challenge.json")
 
 
@@ -3283,6 +3485,9 @@ def main() -> None:
         return
     if "--fabric" in sys.argv:
         _fabric_mode()
+        return
+    if "--fleet-obs" in sys.argv:
+        _fleet_obs_mode()
         return
     if "--challenge" in sys.argv:
         _challenge_mode()

@@ -1,0 +1,76 @@
+"""Fleet observability on vs off: the same seeded scenario through
+real worker processes must produce a byte-identical ban log whether or
+not forwarded chunks carry origin trace context (ISSUE 20 satellite).
+
+``fleet_obs=True`` arms ``--trace-propagation 1`` on every worker AND
+the origin section on every forwarded frame — the observability plane
+rides the data path, so this A/B proves it is *pure* observation:
+same decisions, same fabric ledger, with and without it.  The kill
+arm (slow) adds a SIGKILL mid-flood: takeover + journal replay must
+converge identically with origin sections riding the replayed frames.
+"""
+
+import pytest
+
+from banjax_tpu.fabric.harness import run_fabric
+
+_SEED = 20260807
+_SHAPE = "flash_crowd"
+
+_reports = {}
+
+
+def _run(fleet_obs, kill):
+    key = (fleet_obs, kill)
+    if key not in _reports:
+        _reports[key] = run_fabric(
+            n_workers=2, shape=_SHAPE, seed=_SEED, scale=0.5,
+            kill=kill, fleet_obs=fleet_obs,
+        )
+    return _reports[key]
+
+
+def _assert_clean(report):
+    bad = [k for k, ok in report["invariants"].items() if not ok]
+    assert not bad, bad
+    assert report["fed_lines"] == report["acked_lines"]
+
+
+def _ban_log_bytes(report):
+    return ("\n".join(report["ban_log"]) + "\n").encode()
+
+
+def test_fleet_obs_on_vs_off_ban_log_byte_identical_clean_run():
+    ref = _run(fleet_obs=False, kill=False)
+    obs = _run(fleet_obs=True, kill=False)
+    _assert_clean(ref)
+    _assert_clean(obs)
+    assert ref["oracle_bans"] > 0
+    assert _ban_log_bytes(obs) == _ban_log_bytes(ref)
+
+
+def test_fleet_obs_fabric_ledger_identical_clean_run():
+    """Origin sections must not change WHAT moves — only annotate it:
+    the per-worker routed/forwarded/shed ledger matches exactly."""
+    ref = _run(fleet_obs=False, kill=False)
+    obs = _run(fleet_obs=True, kill=False)
+    for w, ref_w in ref["per_worker"].items():
+        obs_fab = obs["per_worker"][w]["fabric"]
+        for k in ("FabricReceivedLines", "FabricLocalLines",
+                  "FabricForwardedLines", "FabricShedLines"):
+            assert obs_fab.get(k, 0) == ref_w["fabric"].get(k, 0), (
+                f"{w}.{k}"
+            )
+
+
+@pytest.mark.slow
+def test_fleet_obs_sigkill_mid_flood_converges_identically():
+    ref = _run(fleet_obs=False, kill=True)
+    obs = _run(fleet_obs=True, kill=True)
+    _assert_clean(ref)
+    _assert_clean(obs)
+    for r in (ref, obs):
+        assert r["recall"] == 1.0
+        assert r["precision"] == 1.0
+        assert r["takeover"]["victim"] == r["killed"]
+    assert _ban_log_bytes(obs) == _ban_log_bytes(ref)

@@ -1,0 +1,232 @@
+"""Fleet observability end-to-end (ISSUE 20): two REAL worker
+processes over real sockets.  One episode proves the acceptance
+narrative in order:
+
+  1. a chunk tailed at w0 whose lines hash to w1 produces a ban whose
+     provenance on w1 carries ``(origin_node=w0, origin_trace_id)``,
+     and that trace id joins a ``fabric.route`` span in w0's ring and
+     a ``fabric.remote-drain`` span in w1's ring — the cross-host
+     trace join, across process boundaries;
+  2. a federated scrape over the live peer wire merges both nodes'
+     expositions into one strictly-parseable payload with summed
+     fleet counters and per-instance gauges;
+  3. T_FLIGHTREC fan-out returns each ALIVE member's capture files;
+  4. after SIGKILLing w1 mid-scrape the next merge is partial but
+     honest: still parseable, w1 flagged unreachable + stale."""
+
+import json
+import threading
+import time
+
+from banjax_tpu.fabric import wire
+from banjax_tpu.fabric.harness import _fake_broker, _spawn
+from banjax_tpu.fabric.hashring import ConsistentHashRing
+from banjax_tpu.obs.exposition import parse_text_format
+from banjax_tpu.obs.fleet import PEER_CAPTURE_FILES, FleetScraper, capture_fleet
+from banjax_tpu.scenarios.shapes import T0
+
+_READY_TIMEOUT_S = 420.0
+
+LOCAL_TEXT = (
+    "# HELP banjax_x_total t\n# TYPE banjax_x_total counter\n"
+    "banjax_x_total 1\n"
+)
+
+
+def _hello(workers):
+    return {
+        "peers": {w.wid: ["127.0.0.1", w.port] for w in workers.values()},
+        "vnodes": 64,
+        "send_timeout_ms": 2000.0,
+        "grace_ms": 200.0,
+        "inflight_frames": 8,
+        "wire_v2": True,
+        "shm": False,
+        "trace_propagation": True,
+    }
+
+
+def _owned_ip(owner):
+    ring = ConsistentHashRing(("w0", "w1"), vnodes=64)
+    i = 0
+    while True:
+        ip = f"10.{(i >> 8) & 255}.{i & 255}.7"
+        if ring.owner(ip) == owner:
+            return ip
+        i += 1
+
+
+def _probe_lines(ip, n=20):
+    # login_probe: 8 hits / 5 s -> iptables_block; 20 hits in 2 s bans
+    return [
+        f"{T0 + i * 0.1:.6f} {ip} GET example.com GET /wp-login.php "
+        "HTTP/1.1 scanner -"
+        for i in range(n)
+    ]
+
+
+def _spans(files):
+    return json.loads(files["trace.json"])["traceEvents"]
+
+
+def test_fleet_observability_episode(tmp_path):
+    broker = _fake_broker()
+    broker.start()
+    workers = {}
+    try:
+        for wid in ("w0", "w1"):
+            workers[wid] = _spawn(
+                wid, broker.port, str(tmp_path / f"{wid}.err"),
+                extra_args=("--trace-propagation", "1"),
+            )
+        threads = [
+            threading.Thread(
+                target=w.read_ready, args=(_READY_TIMEOUT_S,), daemon=True
+            )
+            for w in workers.values()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(_READY_TIMEOUT_S + 5)
+        bad = [w.wid for w in workers.values() if w.port is None]
+        assert not bad, f"workers failed to start: {bad}"
+
+        hello = _hello(workers)
+        for w in workers.values():
+            w.request(wire.T_HELLO, hello)
+
+        # ---- 1. forwarded-ban trace join -----------------------------
+        ip = _owned_ip("w1")  # tailed at w0, owned by w1
+        workers["w0"].request(
+            wire.T_LINES, {"lines": _probe_lines(ip), "route": True}
+        )
+        workers["w0"].request(wire.T_FLUSH, {"timeout": 600})
+        workers["w1"].request(wire.T_FLUSH, {"timeout": 600})
+
+        explain = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            explain = workers["w1"].request(wire.T_EXPLAIN, {"ip": ip})
+            if explain["records"]:
+                break
+            time.sleep(0.25)
+        assert explain["node_id"] == "w1"
+        assert explain["records"], f"no ban recorded for {ip}"
+        origin_recs = [
+            r for r in explain["records"] if r.get("origin_node") == "w0"
+        ]
+        assert origin_recs, explain["records"]
+        origin_tid = origin_recs[0]["origin_trace_id"]
+        assert origin_tid > 0
+
+        # the SAME trace id appears in BOTH processes' span rings:
+        # w0 allocated it at admission (fabric.route), w1 opened the
+        # linked owner-side drain span (fabric.remote-drain) under it
+        cap0 = workers["w0"].request(
+            wire.T_FLIGHTREC, {"incident": "join-probe", "from": "t"}
+        )
+        cap1 = workers["w1"].request(
+            wire.T_FLIGHTREC, {"incident": "join-probe", "from": "t"}
+        )
+        route_tids = {
+            e["args"]["trace_id"] for e in _spans(cap0["files"])
+            if e["name"] == "fabric.route"
+        }
+        drain = [
+            e for e in _spans(cap1["files"])
+            if e["name"] == "fabric.remote-drain"
+        ]
+        assert origin_tid in route_tids
+        assert any(
+            e["args"]["trace_id"] == origin_tid
+            and e["args"]["origin_node"] == "w0"
+            for e in drain
+        ), drain
+
+        # ---- 2. federated metrics over the live peer wire ------------
+        def pull(w):
+            def _pull():
+                r = w.request(wire.T_STATS, {"metrics": True})
+                if "metrics_text" not in r:
+                    raise OSError(r.get("metrics_error", "no metrics"))
+                return r["metrics_text"]
+
+            return _pull
+
+        scraper = FleetScraper(
+            "driver", lambda: LOCAL_TEXT,
+            peers_fn=lambda: {w.wid: pull(w) for w in workers.values()},
+        )
+        merged = scraper.scrape()
+        parsed = parse_text_format(merged)  # strict parse of the merge
+        # both engines processed lines: the summed fleet counter covers
+        # the whole chunk regardless of which shard drained it
+        total = sum(
+            v for _n, _l, v in
+            parsed["banjax_pipeline_processed_lines_total"]["samples"]
+        )
+        assert total >= 20
+        unreach = {
+            labels["instance"]: v
+            for _n, labels, v in
+            parsed["banjax_fleet_peer_unreachable"]["samples"]
+        }
+        assert unreach == {"driver": 0, "w0": 0, "w1": 0}
+        # gauges carry instance labels per node
+        health_insts = {
+            labels.get("instance")
+            for _n, labels, _v in
+            parsed["banjax_pipeline_buffered_lines"]["samples"]
+        }
+        assert {"w0", "w1"} <= health_insts
+
+        # ---- 3. cluster incident capture fan-out ---------------------
+        def cap(w):
+            def _cap(incident):
+                r = w.request(
+                    wire.T_FLIGHTREC, {"incident": incident, "from": "t"}
+                )
+                return r["files"]
+
+            return _cap
+
+        bundles = capture_fleet(
+            "inc-episode",
+            lambda: {w.wid: cap(w) for w in workers.values()},
+        )
+        for wid in ("w0", "w1"):
+            assert set(PEER_CAPTURE_FILES) <= set(bundles[wid]), wid
+            parse_text_format(bundles[wid]["metrics.prom"])
+
+        # ---- 4. SIGKILL one member: partial but honest ---------------
+        workers["w1"].kill()
+        workers["w1"].proc.wait(timeout=10)
+        merged = scraper.scrape()
+        parsed = parse_text_format(merged)  # STILL strictly parseable
+        unreach = {
+            labels["instance"]: v
+            for _n, labels, v in
+            parsed["banjax_fleet_peer_unreachable"]["samples"]
+        }
+        assert unreach["w1"] == 1
+        assert unreach["w0"] == 0
+        stale = {
+            labels["instance"]: v
+            for _n, labels, v in
+            parsed["banjax_fleet_peer_staleness_seconds"]["samples"]
+        }
+        assert stale["w1"] >= 0.0
+        # the dead member's cached families are still merged in
+        assert {"w0", "w1"} <= {
+            labels.get("instance")
+            for _n, labels, _v in
+            parsed["banjax_pipeline_buffered_lines"]["samples"]
+        }
+    finally:
+        for w in workers.values():
+            try:
+                w.shutdown()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                w.proc.kill()
+        broker.stop()

@@ -327,3 +327,33 @@ def test_serve_fastpath_and_ipset_keys_defaults_and_validation():
     ):
         with pytest.raises(ValueError):
             config_from_yaml_text(bad)
+
+
+def test_fleet_observability_keys_defaults_and_validation():
+    """ISSUE 20: the fleet observability plane's four config keys."""
+    cfg = config_from_yaml_text("")
+    assert cfg.fabric_trace_propagation is False
+    assert cfg.fleet_metrics_enabled is False
+    assert cfg.fleet_scrape_timeout_ms == 750.0
+    assert cfg.flightrec_fleet_capture is False
+
+    cfg = config_from_yaml_text(
+        "fabric_trace_propagation: true\n"
+        "fleet_metrics_enabled: true\n"
+        "fleet_scrape_timeout_ms: 250\n"
+        "flightrec_fleet_capture: true\n"
+    )
+    assert cfg.fabric_trace_propagation is True
+    assert cfg.fleet_metrics_enabled is True
+    assert cfg.fleet_scrape_timeout_ms == 250.0
+    assert cfg.flightrec_fleet_capture is True
+
+    for bad in (
+        "fleet_scrape_timeout_ms: 0",
+        "fleet_scrape_timeout_ms: -5",
+        'fleet_metrics_enabled: "yes"',
+        'fabric_trace_propagation: "on"',
+        'flightrec_fleet_capture: 1.5',
+    ):
+        with pytest.raises(ValueError):
+            config_from_yaml_text(bad)

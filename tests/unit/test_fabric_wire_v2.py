@@ -170,7 +170,7 @@ def test_node_answers_version_handshake():
     try:
         rt, rp = _rpc(node.port, wire.T_VERSION, {"wire": 2, "node": "x"})
         assert rt == wire.T_VERSION_R
-        assert rp == {"wire": wire.WIRE_VERSION, "ring": True}
+        assert rp == {"wire": wire.WIRE_VERSION, "ring": True, "trace": True}
     finally:
         node.stop()
 

@@ -1,0 +1,423 @@
+"""Fleet observability plane units (ISSUE 20): exposition merge
+semantics (counter-sum / gauge-instance-label / histogram-bucket-add),
+the strict round-trip of the merged payload, the origin index the
+cross-host trace join rides on, health-bit packing, the federated
+scraper's partial-but-honest degradation, and the incident capture
+fan-out."""
+
+import pytest
+
+from banjax_tpu.fabric import wire
+from banjax_tpu.obs import registry
+from banjax_tpu.obs.exposition import parse_text_format
+from banjax_tpu.obs.fleet import (
+    HEALTH_BREAKER_HALF_OPEN,
+    HEALTH_BREAKER_OPEN,
+    HEALTH_SLO_BREACHED,
+    FleetScraper,
+    OriginIndex,
+    capture_fleet,
+    compute_health_bits,
+    local_capture_files,
+    merge_expositions,
+)
+from banjax_tpu.resilience import failpoints
+from banjax_tpu.resilience.breaker import CLOSED, HALF_OPEN, OPEN
+
+
+def _samples(parsed, fam):
+    return {
+        (name, tuple(sorted(labels.items()))): value
+        for name, labels, value in parsed[fam]["samples"]
+    }
+
+
+# ---------------------------------------------------------------- merge
+
+
+COUNTER_A = (
+    "# HELP banjax_x_total things\n"
+    "# TYPE banjax_x_total counter\n"
+    'banjax_x_total{kind="a"} 3\n'
+    'banjax_x_total{kind="b"} 10\n'
+)
+COUNTER_B = (
+    "# HELP banjax_x_total things\n"
+    "# TYPE banjax_x_total counter\n"
+    'banjax_x_total{kind="a"} 4\n'
+)
+
+
+def test_merge_counters_sum_per_labelset_without_instance_label():
+    merged = merge_expositions({"w0": COUNTER_A, "w1": COUNTER_B})
+    parsed = parse_text_format(merged)
+    sams = _samples(parsed, "banjax_x_total")
+    assert sams[("banjax_x_total", (("kind", "a"),))] == 7
+    assert sams[("banjax_x_total", (("kind", "b"),))] == 10
+    # the fleet total carries NO instance label: single-node alert
+    # rules keep matching the cluster aggregate
+    for _, labels, _v in parsed["banjax_x_total"]["samples"]:
+        assert "instance" not in labels
+
+
+def test_merge_gauges_labeled_per_instance_never_summed():
+    g = (
+        "# HELP banjax_g current state\n"
+        "# TYPE banjax_g gauge\n"
+        "banjax_g 5\n"
+    )
+    g2 = g.replace(" 5", " 7")
+    merged = merge_expositions({"w0": g, "w1": g2})
+    parsed = parse_text_format(merged)
+    sams = _samples(parsed, "banjax_g")
+    assert sams[("banjax_g", (("instance", "w0"),))] == 5
+    assert sams[("banjax_g", (("instance", "w1"),))] == 7
+
+
+HIST_A = (
+    "# HELP banjax_h_seconds latency\n"
+    "# TYPE banjax_h_seconds histogram\n"
+    'banjax_h_seconds_bucket{le="0.5"} 1\n'
+    'banjax_h_seconds_bucket{le="+Inf"} 2\n'
+    "banjax_h_seconds_sum 0.9\n"
+    "banjax_h_seconds_count 2\n"
+)
+HIST_B = (
+    "# HELP banjax_h_seconds latency\n"
+    "# TYPE banjax_h_seconds histogram\n"
+    'banjax_h_seconds_bucket{le="1.0"} 3\n'
+    'banjax_h_seconds_bucket{le="+Inf"} 3\n'
+    "banjax_h_seconds_sum 1.0\n"
+    "banjax_h_seconds_count 3\n"
+)
+
+
+def test_merge_histograms_union_bounds_carry_forward_and_sum():
+    merged = merge_expositions({"w0": HIST_A, "w1": HIST_B})
+    parsed = parse_text_format(merged)
+    by_le = {
+        labels["le"]: value
+        for name, labels, value in parsed["banjax_h_seconds"]["samples"]
+        if name == "banjax_h_seconds_bucket"
+    }
+    # union of bounds: 0.5 from A, 1.0 from B, +Inf from both.
+    # At 0.5: A=1, B has no bound below -> 0.  At 1.0: A carries its
+    # 0.5 count forward (1), B=3 -> 4.  At +Inf: 2+3.
+    assert by_le["0.5"] == 1
+    assert by_le["1.0"] == 4
+    assert by_le["+Inf"] == 5
+    sams = _samples(parsed, "banjax_h_seconds")
+    assert sams[("banjax_h_seconds_sum", ())] == pytest.approx(1.9)
+    assert sams[("banjax_h_seconds_count", ())] == 5
+
+
+def test_merge_output_round_trips_the_strict_parser():
+    # the parser enforces: trailing newline, TYPE before samples,
+    # histogram monotonicity + sum/count consistency, counter
+    # non-negativity — the merged text must satisfy ALL of it
+    merged = merge_expositions({
+        "w0": COUNTER_A + HIST_A,
+        "w1": COUNTER_B + HIST_B,
+    })
+    parsed = parse_text_format(merged)
+    assert set(parsed) == {"banjax_x_total", "banjax_h_seconds"}
+
+
+def test_merge_single_instance_is_semantically_identity():
+    merged = merge_expositions({"w0": COUNTER_A + HIST_A})
+    parsed = parse_text_format(merged)
+    assert _samples(parsed, "banjax_x_total") == _samples(
+        parse_text_format(COUNTER_A), "banjax_x_total"
+    )
+
+
+# --------------------------------------------------------- origin index
+
+
+def test_origin_index_note_resolve_and_lru_eviction():
+    idx = OriginIndex(max_entries=16)
+    for i in range(20):
+        idx.note(f"1.2.3.{i}", "w0", 100 + i)
+    assert len(idx) == 16
+    # the 4 oldest attributions were evicted
+    assert idx.resolve("1.2.3.0") is None
+    assert idx.resolve("1.2.3.19") == ("w0", 119)
+
+
+def test_origin_index_renote_moves_to_back_and_overwrites():
+    idx = OriginIndex(max_entries=16)
+    idx.note("9.9.9.9", "w0", 1)
+    for i in range(15):
+        idx.note(f"1.2.3.{i}", "w1", i)
+    idx.note("9.9.9.9", "w2", 2)  # re-noted: now the newest
+    idx.note("1.2.3.99", "w1", 99)  # evicts 1.2.3.0, not 9.9.9.9
+    assert idx.resolve("9.9.9.9") == ("w2", 2)
+    assert idx.resolve("1.2.3.0") is None
+
+
+def test_origin_index_empty_origin_is_a_noop():
+    idx = OriginIndex()
+    idx.note("1.2.3.4", "", 7)
+    assert idx.resolve("1.2.3.4") is None
+
+
+# ----------------------------------------------------------- health bits
+
+
+class _Slo:
+    def __init__(self, breached):
+        self._b = breached
+
+    def breached(self):
+        return {"shed": self._b}
+
+
+class _Matcher:
+    def __init__(self, state):
+        self.breaker = type("B", (), {"state": state})()
+
+
+def test_compute_health_bits_packs_slo_and_breaker():
+    assert compute_health_bits() == 0
+    assert compute_health_bits(slo=_Slo(True)) == HEALTH_SLO_BREACHED
+    assert compute_health_bits(matcher=_Matcher(OPEN)) == HEALTH_BREAKER_OPEN
+    assert compute_health_bits(
+        matcher=_Matcher(HALF_OPEN)
+    ) == HEALTH_BREAKER_HALF_OPEN
+    assert compute_health_bits(
+        slo=_Slo(True), matcher=_Matcher(OPEN)
+    ) == HEALTH_SLO_BREACHED | HEALTH_BREAKER_OPEN
+    assert compute_health_bits(slo=_Slo(False),
+                               matcher=_Matcher(CLOSED)) == 0
+
+
+def test_compute_health_bits_swallows_provider_bugs():
+    class Bad:
+        def breached(self):
+            raise RuntimeError("boom")
+
+    assert compute_health_bits(slo=Bad()) == 0
+
+
+# -------------------------------------------------------------- scraper
+
+
+LOCAL = COUNTER_A
+
+
+def _fleet_gauges(text, fam):
+    parsed = parse_text_format(text)
+    return {
+        labels["instance"]: value
+        for _n, labels, value in parsed[fam]["samples"]
+    }
+
+
+def test_scraper_merges_local_and_fresh_peer():
+    scraper = FleetScraper(
+        "w0", lambda: LOCAL, peers_fn=lambda: {"w1": lambda: COUNTER_B}
+    )
+    text = scraper.scrape()
+    parsed = parse_text_format(text)
+    sams = _samples(parsed, "banjax_x_total")
+    assert sams[("banjax_x_total", (("kind", "a"),))] == 7
+    unreach = _fleet_gauges(text, "banjax_fleet_peer_unreachable")
+    assert unreach == {"w0": 0, "w1": 0}
+    stale = _fleet_gauges(text, "banjax_fleet_peer_staleness_seconds")
+    assert stale == {"w0": 0, "w1": 0}
+
+
+def test_scraper_dead_peer_is_partial_but_honest_never_a_raise():
+    clock = [100.0]
+    calls = {"n": 0}
+
+    def pull():
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise OSError("peer died")
+        return COUNTER_B
+
+    scraper = FleetScraper(
+        "w0", lambda: LOCAL, peers_fn=lambda: {"w1": pull},
+        clock=lambda: clock[0],
+    )
+    scraper.scrape()  # caches w1
+    clock[0] = 107.5
+    text = scraper.scrape()  # w1 now dead -> cached + flagged
+    parsed = parse_text_format(text)  # still strictly parseable
+    assert _samples(parsed, "banjax_x_total")[
+        ("banjax_x_total", (("kind", "a"),))
+    ] == 7  # cached snapshot still merged in
+    assert _fleet_gauges(text, "banjax_fleet_peer_unreachable")["w1"] == 1
+    assert _fleet_gauges(
+        text, "banjax_fleet_peer_staleness_seconds"
+    )["w1"] == pytest.approx(7.5)
+
+
+def test_scraper_dead_peer_with_no_cache_drops_out_flagged():
+    def pull():
+        raise OSError("never reachable")
+
+    scraper = FleetScraper(
+        "w0", lambda: LOCAL, peers_fn=lambda: {"w1": pull}
+    )
+    text = scraper.scrape()
+    parsed = parse_text_format(text)
+    assert _samples(parsed, "banjax_x_total")[
+        ("banjax_x_total", (("kind", "a"),))
+    ] == 3  # local only
+    assert _fleet_gauges(text, "banjax_fleet_peer_unreachable")["w1"] == 1
+
+
+def test_scraper_corrupt_peer_payload_treated_as_unreachable():
+    scraper = FleetScraper(
+        "w0", lambda: LOCAL,
+        peers_fn=lambda: {"w1": lambda: "not a prometheus payload"},
+    )
+    text = scraper.scrape()
+    parse_text_format(text)
+    assert _fleet_gauges(text, "banjax_fleet_peer_unreachable")["w1"] == 1
+
+
+def test_scraper_pull_failpoint_degrades_that_peer():
+    try:
+        failpoints.arm("obs.fleet.pull", count=1)
+        scraper = FleetScraper(
+            "w0", lambda: LOCAL,
+            peers_fn=lambda: {"w1": lambda: COUNTER_B},
+        )
+        text = scraper.scrape()
+        assert _fleet_gauges(
+            text, "banjax_fleet_peer_unreachable"
+        )["w1"] == 1
+        text = scraper.scrape()  # failpoint exhausted -> fresh again
+        assert _fleet_gauges(
+            text, "banjax_fleet_peer_unreachable"
+        )["w1"] == 0
+    finally:
+        failpoints.disarm()
+
+
+def test_fleet_collect_sums_pipeline_counters_across_instances():
+    def node(admitted, processed, shed, drain_err, stale):
+        return (
+            "# HELP banjax_pipeline_admitted_lines_total a\n"
+            "# TYPE banjax_pipeline_admitted_lines_total counter\n"
+            f"banjax_pipeline_admitted_lines_total {admitted}\n"
+            "# HELP banjax_pipeline_processed_lines_total p\n"
+            "# TYPE banjax_pipeline_processed_lines_total counter\n"
+            f"banjax_pipeline_processed_lines_total {processed}\n"
+            "# HELP banjax_pipeline_shed_lines_total s\n"
+            "# TYPE banjax_pipeline_shed_lines_total counter\n"
+            f"banjax_pipeline_shed_lines_total {shed}\n"
+            "# HELP banjax_pipeline_drain_error_lines_total d\n"
+            "# TYPE banjax_pipeline_drain_error_lines_total counter\n"
+            f"banjax_pipeline_drain_error_lines_total {drain_err}\n"
+            "# HELP banjax_pipeline_stale_dropped_lines_total st\n"
+            "# TYPE banjax_pipeline_stale_dropped_lines_total counter\n"
+            f"banjax_pipeline_stale_dropped_lines_total {stale}\n"
+        )
+
+    scraper = FleetScraper(
+        "w0", lambda: node(100, 90, 5, 1, 4),
+        peers_fn=lambda: {"w1": lambda: node(50, 48, 2, 0, 0)},
+    )
+    assert scraper.fleet_collect() == {}  # no scrape yet
+    scraper.scrape()
+    got = scraper.fleet_collect()
+    assert got["admitted"] == 150
+    assert got["processed"] == 138
+    assert got["shed"] == 8  # shed + drain_error, both instances
+    assert got["stale"] == 4
+
+
+# -------------------------------------------------------------- capture
+
+
+def test_local_capture_files_shapes():
+    files = local_capture_files(
+        metrics_text_fn=lambda: LOCAL,
+        fabric_fn=lambda: {"enabled": True, "node_id": "w0"},
+    )
+    assert set(files) == {
+        "trace.json", "metrics.prom", "provenance.json", "fabric.json"
+    }
+    assert files["metrics.prom"] == LOCAL
+
+
+def test_capture_fleet_failed_peer_contributes_error_txt():
+    def peers():
+        return {
+            "w1": lambda incident: {"metrics.prom": LOCAL},
+            "w2": lambda incident: (_ for _ in ()).throw(OSError("dead")),
+        }
+
+    out = capture_fleet("inc-1", peers)
+    assert out["w1"] == {"metrics.prom": LOCAL}
+    assert list(out["w2"]) == ["error.txt"]
+    assert "dead" in out["w2"]["error.txt"]
+
+
+def test_capture_fleet_failpoint_and_filename_sanitization():
+    try:
+        failpoints.arm("obs.fleet.capture", count=1)
+        out = capture_fleet(
+            "inc-2", lambda: {"w1": lambda i: {"metrics.prom": "x\n"}}
+        )
+        assert list(out["w1"]) == ["error.txt"]
+    finally:
+        failpoints.disarm()
+    out = capture_fleet(
+        "inc-3",
+        lambda: {"w1": lambda i: {
+            "../escape": "no", "/abs": "no", "ok.json": "yes",
+        }},
+    )
+    assert out["w1"] == {"ok.json": "yes"}
+
+
+# ------------------------------------------------- wire origin sections
+
+
+def test_wire_v2_origin_roundtrip_both_frames():
+    lines = ["a", "b", "c"]
+    buf = wire.encode_lines_v2(
+        7, lines, origin_node="w0", origin_runs=((0, 2), (2, 1)),
+        origin_t_read=123.5,
+    )
+    fr = wire.decode_lines_v2(buf[wire._HEADER.size:])
+    assert fr.lines == tuple(lines)
+    assert fr.origin_node == "w0"
+    assert fr.origin_runs == ((0, 2), (2, 1))
+    assert fr.origin_t_read == pytest.approx(123.5)
+    # no origin -> empty section, decodes to the defaults
+    buf = wire.encode_lines_v2(8, lines)
+    fr = wire.decode_lines_v2(buf[wire._HEADER.size:])
+    assert fr.origin_node == ""
+    assert fr.origin_runs == ()
+    assert fr.origin_t_read == 0.0
+
+
+def test_wire_v2_origin_defaults_whole_chunk_run():
+    buf = wire.encode_lines_v2(9, ["x", "y"], origin_node="w3")
+    fr = wire.decode_lines_v2(buf[wire._HEADER.size:])
+    assert fr.origin_runs == ((0, 2),)
+
+
+# ------------------------------------------------------ registry schema
+
+
+def test_fleet_families_declared_in_registry():
+    fams = registry.PROM_FAMILIES
+    assert fams["banjax_fabric_peer_health"].kind == "gauge"
+    assert fams["banjax_fabric_peer_health"].labels == ("node",)
+    assert fams["banjax_fleet_peer_unreachable"].kind == "gauge"
+    assert fams["banjax_fleet_peer_unreachable"].labels == ("instance",)
+    assert fams["banjax_fleet_peer_staleness_seconds"].kind == "gauge"
+    assert fams["banjax_e2e_latency_seconds"].kind == "histogram"
+    assert fams["banjax_e2e_latency_seconds"].labels == ("hop",)
+
+
+def test_fleet_failpoint_sites_are_known():
+    assert "obs.fleet.pull" in failpoints.KNOWN_SITES
+    assert "obs.fleet.capture" in failpoints.KNOWN_SITES

@@ -168,3 +168,72 @@ def test_bundle_traffic_section_survives_a_failing_fn(tmp_path):
         (tmp_path / "incidents" / name / "traffic.json").read_text()
     )
     assert doc["enabled"] is False and "error" in doc
+
+
+def test_bundle_peers_tree_from_fleet_capture(tmp_path):
+    """ISSUE 20: a cluster incident bundle grows a peers/<node_id>/
+    tree with each ALIVE member's contribution, listed in meta.json
+    and readable through the nested read surface."""
+    rec = _recorder(
+        tmp_path,
+        metrics_text_fn=lambda: "m 1\n",
+        fleet_capture_fn=lambda incident: {
+            "w1": {"metrics.prom": "m 2\n",
+                   "fabric.json": '{"enabled": true}'},
+            "w2": {"error.txt": "capture failed: dead\n"},
+        },
+    )
+    name = rec.notify("fabric-takeover", "w2 died")
+    bundle = tmp_path / "incidents" / name
+    assert (bundle / "peers" / "w1" / "metrics.prom").read_text() == "m 2\n"
+    assert (bundle / "peers" / "w2" / "error.txt").read_text().startswith(
+        "capture failed"
+    )
+    meta = json.loads((bundle / "meta.json").read_text())
+    assert "peers/w1/metrics.prom" in meta["files"]
+    assert "peers/w2/error.txt" in meta["files"]
+    # nested read surface
+    assert rec.read_file(name, "peers/w1/metrics.prom") == b"m 2\n"
+    assert rec.read_file(name, "peers/nope/metrics.prom") is None
+    # traversal through the nested form is refused, not resolved
+    assert rec.read_file(name, "peers/../meta.json") is None
+    assert rec.read_file(name, "peers/w1/../../meta.json") is None
+    assert rec.read_file(name, "peers/w1/.hidden") is None
+
+
+def test_bundle_fleet_capture_failure_never_propagates(tmp_path):
+    def boom(incident):
+        raise RuntimeError("fan-out exploded")
+
+    rec = _recorder(tmp_path, fleet_capture_fn=boom)
+    name = rec.notify("breaker-trip")
+    assert name is not None  # the local bundle still lands
+    bundle = tmp_path / "incidents" / name
+    assert not (bundle / "peers").exists()
+
+
+def test_bundle_fleet_capture_sanitizes_hostile_names(tmp_path):
+    """Hostile node ids / file names from a compromised peer are
+    basenamed into the bundle — nothing ever lands outside it, and
+    dot-prefixed names are dropped."""
+    rec = _recorder(
+        tmp_path,
+        fleet_capture_fn=lambda incident: {
+            "../evil": {"x": "contained"},
+            "w1": {"../../escape": "contained", "ok.txt": "yes",
+                   ".hidden": "dropped"},
+        },
+    )
+    name = rec.notify("chaos")
+    bundle = tmp_path / "incidents" / name
+    assert (bundle / "peers" / "w1" / "ok.txt").read_text() == "yes"
+    # traversal components are stripped: the payloads land INSIDE the
+    # bundle under their basenames, never beside/above it
+    assert not (tmp_path / "incidents" / "evil").exists()
+    assert not (tmp_path / "escape").exists()
+    assert (bundle / "peers" / "evil" / "x").read_text() == "contained"
+    assert (bundle / "peers" / "w1" / "escape").read_text() == "contained"
+    assert not (bundle / "peers" / "w1" / ".hidden").exists()
+    meta = json.loads((bundle / "meta.json").read_text())
+    assert "peers/w1/ok.txt" in meta["files"]
+    assert all(".." not in f for f in meta["files"])

@@ -204,3 +204,36 @@ def test_background_sampling_thread_starts_and_stops():
     _time.sleep(0.2)
     eng.stop()
     assert len(eng._samples) >= 2
+
+
+def test_collect_fn_replaces_local_collection_fleet_mode():
+    """ISSUE 20: a fleet-mode engine burns an injected counter stream
+    (obs/fleet.py fleet_collect) with the same window mechanics as a
+    node burning its own pipeline."""
+    clock = Clock()
+    feed = {"admitted": 0.0, "processed": 0.0, "shed": 0.0, "stale": 0.0}
+    breaches = []
+    eng = SloEngine(
+        collect_fn=lambda: dict(feed),
+        shed_ratio_max=0.01,
+        clock=clock,
+        on_breach=lambda name, burn: breaches.append(name),
+    )
+    eng.sample()
+    clock.t += 60
+    feed.update(admitted=1000.0, processed=500.0, shed=500.0)
+    newly = eng.sample()
+    assert SLO_SHED in newly
+    assert breaches == [SLO_SHED]
+    assert eng.burn_rates()[SLO_SHED]["5m"] == pytest.approx(50.0, rel=0.01)
+
+
+def test_collect_fn_failure_degrades_to_empty_sample():
+    clock = Clock()
+
+    def boom():
+        raise RuntimeError("scrape machinery died")
+
+    eng = SloEngine(collect_fn=boom, clock=clock)
+    assert eng.sample() == []  # never raises out of the sampler
+    assert all(v is False for v in eng.breached().values())
